@@ -1,0 +1,35 @@
+"""avenir_tpu — a TPU-native data-mining framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference system
+(zhanglei/avenir, a Hadoop-MapReduce + Storm batch/streaming data-mining
+toolkit): Naive Bayes, mutual-information / correlation feature analysis,
+decision trees, k-nearest-neighbor, Markov / hidden-Markov sequence models,
+logistic regression, Fisher discriminant, multi-armed bandits (batch and
+online), and class-balancing samplers.
+
+Architecture (vs the reference's layers, see SURVEY.md):
+
+  L0' JAX/XLA + TPU runtime      (replaces Hadoop MR / Storm / Redis / HDFS)
+  L1' core data layer            (replaces chombo: schema, CSV ingest, config)
+  L2' jittable model math        (same inventory as the reference's plain-Java kernels)
+  L3' estimator API fit/predict  (replaces one-Tool-class-per-algorithm MR jobs)
+  L4' in-process pipeline driver (replaces knn.sh / tutorial runbooks)
+      + host streaming loop      (replaces the Storm topology + Redis queues)
+
+The reference's mapper/combiner/reducer triple collapses into
+``vmap(record_kernel)`` + one-hot-einsum/``psum`` aggregation; the MR shuffle
+becomes XLA collectives over ICI; multi-stage HDFS pipelines become function
+composition over in-memory arrays.
+"""
+
+__version__ = "0.1.0"
+
+from avenir_tpu.core.schema import FeatureField, FeatureSchema
+from avenir_tpu.core.config import JobConfig
+
+__all__ = [
+    "FeatureField",
+    "FeatureSchema",
+    "JobConfig",
+    "__version__",
+]

@@ -1,0 +1,5 @@
+from avenir_tpu.core.schema import FeatureField, FeatureSchema
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+
+__all__ = ["FeatureField", "FeatureSchema", "JobConfig", "DatasetEncoder", "EncodedDataset"]

@@ -1,0 +1,11 @@
+"""Synthetic data generators with planted structure.
+
+numpy ports of the reference's Python/Ruby generators (resource/*.py,
+resource/*.rb) — each encodes a ground-truth mechanism the corresponding
+algorithm is expected to recover, which is how the reference is validated
+(SURVEY.md §4). Here they drive automated end-to-end tests.
+"""
+
+from avenir_tpu.datagen.churn import generate_churn, CHURN_SCHEMA_JSON
+
+__all__ = ["generate_churn", "CHURN_SCHEMA_JSON"]

@@ -1,0 +1,3 @@
+from avenir_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
+
+__all__ = ["NaiveBayes", "NaiveBayesModel"]

@@ -1,0 +1,323 @@
+"""Naive Bayes — training and scoring, TPU-native.
+
+Capability parity with the reference's Bayesian suite
+(bayesian/BayesianDistribution.java — training MR;
+bayesian/BayesianPredictor.java — map-only scoring MR;
+bayesian/BayesianModel.java + FeaturePosterior.java — in-memory model):
+
+- binned features (categorical, or numeric with ``bucketWidth``) →
+  class-conditional multinomial bins;
+- unbinned numeric features → Gaussian class-conditional densities from
+  (count, Σx, Σx²) accumulation (reference :156-171, :282-297);
+- class priors, feature priors, posterior product scoring
+  (BayesianModel.java:50-74), argmax or cost-based arbitration with an
+  ambiguity flag on the top-two probability gap
+  (BayesianPredictor.java:319-391);
+- model-file serde in the reference's CSV row layout
+  (BayesianPredictor.java:186-224) for drop-in continuity;
+- validation-mode confusion matrix published to counters
+  (BayesianPredictor.java:170-180).
+
+Architecture: training is one einsum-aggregation pass per chunk
+(:func:`avenir_tpu.ops.agg.feature_class_counts` + :func:`class_moments`) —
+the mapper/combiner/reducer triple collapsed into a contraction the MXU
+executes directly; scoring is a jitted gather of log-probabilities. Deliberate
+fixes over the reference (documented per SURVEY.md §7): float probabilities
+instead of ×100 ints, true float mean/σ instead of integer division, optional
+Laplace smoothing instead of silent zero probabilities.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field as dc_field
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.ops import agg
+from avenir_tpu.utils.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
+
+_LOG2PI = float(np.log(2.0 * np.pi))
+
+
+@dataclass
+class NaiveBayesModel:
+    """Sufficient statistics + derived log-probability tables."""
+
+    class_values: List[str]
+    n_bins: np.ndarray                                  # int [F]
+    bin_counts: np.ndarray                              # float64 [F, B, C]
+    class_counts: np.ndarray                            # float64 [C]
+    cont_count: Optional[np.ndarray] = None             # float64 [C]
+    cont_sum: Optional[np.ndarray] = None               # float64 [C, Fc]
+    cont_sumsq: Optional[np.ndarray] = None             # float64 [C, Fc]
+    laplace: float = 1.0
+
+    # -- derived tables (the analog of BayesianModel.finishUp) ---------------
+    @functools.cached_property
+    def log_prior(self) -> np.ndarray:
+        c = self.class_counts
+        return np.log(np.maximum(c, 1e-300) / max(c.sum(), 1e-300))
+
+    @functools.cached_property
+    def log_posterior(self) -> np.ndarray:
+        """[F, B, C] log P(bin | class), Laplace-smoothed over valid bins."""
+        f, b, _ = self.bin_counts.shape
+        valid = (np.arange(b)[None, :] < self.n_bins[:, None])[..., None]   # [F,B,1]
+        counts = self.bin_counts + self.laplace * valid
+        totals = counts.sum(axis=1, keepdims=True)                          # [F,1,C]
+        probs = np.where(valid, counts / np.maximum(totals, 1e-300), 1.0)
+        return np.log(np.maximum(probs, 1e-300))
+
+    @functools.cached_property
+    def cont_stats(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """([C,Fc] mean, [C,Fc] std) for continuous features, or None."""
+        if self.cont_sum is None or self.cont_sum.size == 0:
+            return None
+        cnt = np.maximum(self.cont_count, 1.0)[:, None]
+        mean = self.cont_sum / cnt
+        var = np.maximum(self.cont_sumsq / cnt - mean ** 2, 1e-12)
+        # unbiased correction to match sample σ (reference divides by n−1)
+        var = var * (cnt / np.maximum(cnt - 1.0, 1.0))
+        return mean, np.sqrt(var)
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.class_values)
+
+    def scoring_params(self):
+        """Device-ready arrays for the jitted scorer."""
+        mean_std = self.cont_stats
+        if mean_std is None:
+            mean = std = np.zeros((self.num_classes, 0), np.float32)
+        else:
+            mean, std = mean_std
+        return (
+            jnp.asarray(self.log_posterior, jnp.float32),
+            jnp.asarray(self.log_prior, jnp.float32),
+            jnp.asarray(mean, jnp.float32),
+            jnp.asarray(std, jnp.float32),
+        )
+
+
+@jax.jit
+def nb_log_scores(
+    log_posterior: jax.Array,   # [F, B, C]
+    log_prior: jax.Array,       # [C]
+    cont_mean: jax.Array,       # [C, Fc]
+    cont_std: jax.Array,        # [C, Fc]
+    codes: jax.Array,           # [N, F]
+    cont: jax.Array,            # [N, Fc]
+) -> jax.Array:
+    """[N, C] unnormalized log P(c | x) = log P(c) + Σ_f log P(x_f | c)."""
+    # gather per-record bin log-probs: [N, F, C]
+    gathered = jnp.take_along_axis(
+        log_posterior[None, :, :, :],            # [1, F, B, C]
+        codes[:, :, None, None].astype(jnp.int32).clip(0),  # [N, F, 1, 1]
+        axis=2,
+    )[:, :, 0, :]
+    scores = log_prior[None, :] + jnp.sum(gathered, axis=1)
+    if cont_mean.shape[1]:
+        x = cont[:, None, :]                     # [N, 1, Fc]
+        mu = cont_mean[None, :, :]               # [1, C, Fc]
+        sd = jnp.maximum(cont_std[None, :, :], 1e-6)
+        logpdf = -0.5 * (((x - mu) / sd) ** 2) - jnp.log(sd) - 0.5 * _LOG2PI
+        scores = scores + jnp.sum(logpdf, axis=2)
+    return scores
+
+
+@dataclass
+class PredictionResult:
+    log_scores: np.ndarray          # [N, C]
+    probs: np.ndarray               # [N, C] normalized posteriors
+    predicted: np.ndarray           # [N] class index after arbitration
+    ambiguous: Optional[np.ndarray] = None      # [N] bool
+    confusion: Optional[ConfusionMatrix] = None
+    counters: Counters = dc_field(default_factory=Counters)
+
+    def predicted_labels(self, class_values: Sequence[str]) -> List[str]:
+        return [class_values[i] for i in self.predicted]
+
+
+class NaiveBayes:
+    """Estimator facade: fit over encoded chunks, predict with arbitration.
+
+    The reference's job pair (BayesianDistribution → model file →
+    BayesianPredictor) becomes ``fit`` → :class:`NaiveBayesModel` →
+    ``predict``; the model file remains available via
+    :func:`model_to_lines` / :func:`model_from_lines`.
+    """
+
+    def __init__(self, laplace: float = 1.0):
+        self.laplace = laplace
+
+    def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]]) -> NaiveBayesModel:
+        chunks = [data] if isinstance(data, EncodedDataset) else data
+        acc = agg.Accumulator()
+        meta: Optional[EncodedDataset] = None
+        for ds in chunks:
+            meta = ds
+            if ds.labels is None:
+                raise ValueError("fit requires labels (class attribute column)")
+            c, b = ds.num_classes, ds.max_bins
+            labels = jnp.asarray(ds.labels)
+            if ds.num_binned:
+                acc.add("bin_counts", agg.feature_class_counts(jnp.asarray(ds.codes), labels, c, b))
+            acc.add("class_counts", agg.class_counts(labels, c))
+            if ds.num_cont:
+                cnt, s1, s2 = agg.class_moments(jnp.asarray(ds.cont), labels, c)
+                acc.add("cont_count", cnt)
+                acc.add("cont_sum", s1)
+                acc.add("cont_sumsq", s2)
+        if meta is None:
+            raise ValueError("no data")
+        f, bmax, cnum = meta.num_binned, meta.max_bins, meta.num_classes
+        return NaiveBayesModel(
+            class_values=list(meta.class_values),
+            n_bins=np.asarray(meta.n_bins, np.int64),
+            bin_counts=(acc.get("bin_counts").astype(np.float64)
+                        if "bin_counts" in acc else np.zeros((f, bmax, cnum))),
+            class_counts=acc.get("class_counts").astype(np.float64),
+            cont_count=(acc.get("cont_count") if "cont_count" in acc else None),
+            cont_sum=(acc.get("cont_sum") if "cont_sum" in acc else None),
+            cont_sumsq=(acc.get("cont_sumsq") if "cont_sumsq" in acc else None),
+            laplace=self.laplace,
+        )
+
+    def predict(
+        self,
+        model: NaiveBayesModel,
+        ds: EncodedDataset,
+        cost: Optional[np.ndarray] = None,
+        ambiguity_threshold: Optional[float] = None,
+        validate: bool = False,
+        pos_class: Optional[str] = None,
+    ) -> PredictionResult:
+        params = model.scoring_params()
+        scores = np.asarray(nb_log_scores(*params, jnp.asarray(ds.codes), jnp.asarray(ds.cont)))
+        shifted = scores - scores.max(axis=1, keepdims=True)
+        expd = np.exp(shifted)
+        probs = expd / expd.sum(axis=1, keepdims=True)
+        if cost is not None:
+            predicted = CostBasedArbitrator(model.class_values, cost).arbitrate(probs)
+        else:
+            predicted = np.argmax(probs, axis=1).astype(np.int32)
+        ambiguous = None
+        if ambiguity_threshold is not None:
+            top2 = np.sort(probs, axis=1)[:, -2:]
+            ambiguous = (top2[:, 1] - top2[:, 0]) < ambiguity_threshold
+        result = PredictionResult(log_scores=scores, probs=probs, predicted=predicted, ambiguous=ambiguous)
+        if validate:
+            if ds.labels is None:
+                raise ValueError("validation mode requires labels")
+            cm = ConfusionMatrix(model.class_values, pos_class=pos_class)
+            cm.add_batch(ds.labels, predicted)
+            cm.publish(result.counters)
+            result.confusion = cm
+        return result
+
+
+# ---------------------------------------------------------------------------
+# model-file serde — the reference's CSV layout (BayesianPredictor.java:186-224)
+# ---------------------------------------------------------------------------
+#   classVal,featureOrd,bin,count            feature posterior (binned)
+#   classVal,featureOrd,,mean,stdDev         feature posterior (continuous)
+#   classVal,,,count                         class prior
+#   ,featureOrd,bin,count                    feature prior (binned)
+#   ,featureOrd,,mean,stdDev                 feature prior (continuous)
+
+def model_to_lines(model: NaiveBayesModel, encoder: DatasetEncoder, delim: str = ",") -> List[str]:
+    lines: List[str] = []
+    ords = [f.ordinal for f in encoder.binned_fields]
+    cont_ords = [f.ordinal for f in encoder.cont_fields]
+    # feature posteriors + priors (binned)
+    for fi, ordinal in enumerate(ords):
+        nb = int(model.n_bins[fi])
+        for b in range(nb):
+            label = encoder.bin_label(fi, b)
+            total = 0
+            for ci, cv in enumerate(model.class_values):
+                cnt = int(model.bin_counts[fi, b, ci])
+                total += cnt
+                if cnt:
+                    lines.append(delim.join([cv, str(ordinal), label, str(cnt)]))
+            if total:
+                lines.append(delim.join(["", str(ordinal), label, str(total)]))
+    # class priors
+    for ci, cv in enumerate(model.class_values):
+        lines.append(delim.join([cv, "", "", str(int(model.class_counts[ci]))]))
+    # continuous posteriors + priors
+    if model.cont_stats is not None:
+        mean, std = model.cont_stats
+        for fj, ordinal in enumerate(cont_ords):
+            for ci, cv in enumerate(model.class_values):
+                lines.append(delim.join([cv, str(ordinal), "", repr(float(mean[ci, fj])), repr(float(std[ci, fj]))]))
+            cnt = model.cont_count
+            tot = max(float(cnt.sum()), 1.0)
+            pm = float((cnt * mean[:, fj]).sum() / tot)
+            # pooled prior σ from total moments
+            s2 = float(model.cont_sumsq[:, fj].sum())
+            pv = max(s2 / tot - pm * pm, 1e-12) * (tot / max(tot - 1.0, 1.0))
+            lines.append(delim.join(["", str(ordinal), "", repr(pm), repr(float(np.sqrt(pv)))]))
+    return lines
+
+
+def model_from_lines(
+    lines: Iterable[str], encoder: DatasetEncoder, laplace: float = 1.0, delim: str = ","
+) -> NaiveBayesModel:
+    """Rebuild a model from the reference-layout CSV rows.
+
+    Continuous rows carry (mean, std) rather than raw moments, so the moments
+    are reconstituted with a nominal count — scoring depends only on
+    (mean, std), which round-trips exactly.
+    """
+    ords = [f.ordinal for f in encoder.binned_fields]
+    cont_ords = [f.ordinal for f in encoder.cont_fields]
+    ord_to_fi = {o: i for i, o in enumerate(ords)}
+    ord_to_cj = {o: j for j, o in enumerate(cont_ords)}
+    class_values = list(encoder.class_values)
+    cmap = {v: i for i, v in enumerate(class_values)}
+    f = len(ords)
+    nb = np.array([encoder.n_bins[o] for o in ords], np.int64) if f else np.zeros(0, np.int64)
+    bmax = int(nb.max()) if f else 0
+    c = len(class_values)
+    bin_counts = np.zeros((f, bmax, c))
+    class_counts = np.zeros(c)
+    fc = len(cont_ords)
+    mean = np.zeros((c, fc))
+    std = np.ones((c, fc))
+    n_nominal = 1000.0
+    for line in lines:
+        items = line.rstrip("\n").split(delim)
+        if not any(items):
+            continue
+        featur_ord = int(items[1]) if items[1] != "" else -1
+        if items[0] == "":
+            continue  # feature priors are derivable; skip
+        if items[1] == "" and items[2] == "":
+            class_counts[cmap[items[0]]] += float(items[3])
+        elif items[2] != "":
+            fi = ord_to_fi[featur_ord]
+            code = encoder.bin_code(fi, items[2])
+            bin_counts[fi, code, cmap[items[0]]] += float(items[3])
+        else:
+            cj = ord_to_cj[featur_ord]
+            ci = cmap[items[0]]
+            mean[ci, cj] = float(items[3])
+            std[ci, cj] = float(items[4])
+    cont_count = cont_sum = cont_sumsq = None
+    if fc:
+        cont_count = np.full(c, n_nominal)
+        cont_sum = mean * n_nominal
+        # invert the unbiased-σ derivation in cont_stats for round-trip
+        var_b = (std ** 2) * ((n_nominal - 1.0) / n_nominal)
+        cont_sumsq = (var_b + mean ** 2) * n_nominal
+    return NaiveBayesModel(
+        class_values=class_values, n_bins=nb, bin_counts=bin_counts,
+        class_counts=class_counts, cont_count=cont_count,
+        cont_sum=cont_sum, cont_sumsq=cont_sumsq, laplace=laplace,
+    )

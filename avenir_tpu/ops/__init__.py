@@ -1,0 +1,3 @@
+from avenir_tpu.ops import agg, info
+
+__all__ = ["agg", "info"]

@@ -1,0 +1,141 @@
+"""Information-theoretic and association statistics over count tensors.
+
+Pure functions from (contingency) count tensors to scalars/vectors. These are
+the rebuild's equivalents of the reference's reducer-side statistics:
+entropy/gini/Hellinger split quality (util/AttributeSplitStat.java:179-339),
+dataset info content (util/InfoContentStat.java:55-85), Cramér index /
+concentration coefficient / uncertainty coefficient
+(util/ContingencyMatrix.java:86-185), and the mutual-information family
+(explore/MutualInformation.java:598-784).
+
+All take *float* count tensors (cast at the boundary) and are safe on empty
+cells (0·log 0 = 0 via masked logs). They operate on the trailing axes so
+they vmap/batch over leading axes (feature pairs, candidate splits, tree
+nodes) for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def _safe_log(x: jax.Array) -> jax.Array:
+    return jnp.log(jnp.where(x > 0, x, 1.0))
+
+
+def normalize(counts: jax.Array, axis=None) -> jax.Array:
+    """Counts → probabilities along ``axis`` (all trailing mass if None)."""
+    total = jnp.sum(counts, axis=axis, keepdims=axis is not None)
+    return counts / jnp.maximum(total, _EPS)
+
+
+def entropy(p: jax.Array, axis: int = -1) -> jax.Array:
+    """Shannon entropy (nats) of a probability vector along ``axis``."""
+    return -jnp.sum(p * _safe_log(p), axis=axis)
+
+
+def entropy_from_counts(counts: jax.Array, axis: int = -1) -> jax.Array:
+    return entropy(normalize(counts, axis=axis), axis=axis)
+
+
+def gini(p: jax.Array, axis: int = -1) -> jax.Array:
+    """Gini impurity 1 − Σp²."""
+    return 1.0 - jnp.sum(p * p, axis=axis)
+
+
+def gini_from_counts(counts: jax.Array, axis: int = -1) -> jax.Array:
+    return gini(normalize(counts, axis=axis), axis=axis)
+
+
+def hellinger_distance(p: jax.Array, q: jax.Array, axis: int = -1) -> jax.Array:
+    """Hellinger distance between two distributions along ``axis``."""
+    return jnp.sqrt(jnp.maximum(jnp.sum((jnp.sqrt(p) - jnp.sqrt(q)) ** 2, axis=axis), 0.0)) / jnp.sqrt(2.0)
+
+
+# ---------------------------------------------------------------------------
+# mutual information family (joint count matrix [..., A, B])
+# ---------------------------------------------------------------------------
+
+def mutual_information(joint_counts: jax.Array) -> jax.Array:
+    """MI(X;Y) in nats from joint counts [..., A, B].
+
+    I = Σ_ab p(a,b) · log( p(a,b) / (p(a)·p(b)) ), with empty cells
+    contributing zero — matching the reference's skip-if-zero loops.
+    """
+    c = joint_counts.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(c, axis=(-2, -1), keepdims=True), _EPS)
+    p = c / total
+    pa = jnp.sum(p, axis=-1, keepdims=True)    # [..., A, 1]
+    pb = jnp.sum(p, axis=-2, keepdims=True)    # [..., 1, B]
+    ratio = p / jnp.maximum(pa * pb, _EPS)
+    return jnp.sum(p * _safe_log(ratio), axis=(-2, -1))
+
+
+def joint_entropy(joint_counts: jax.Array) -> jax.Array:
+    c = joint_counts.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(c, axis=(-2, -1), keepdims=True), _EPS)
+    p = c / total
+    return -jnp.sum(p * _safe_log(p), axis=(-2, -1))
+
+
+def conditional_mutual_information(joint_counts_z: jax.Array) -> jax.Array:
+    """I(X;Y|Z) from counts [..., A, B, Z]: Σ_z p(z) · MI(X;Y | Z=z)."""
+    c = joint_counts_z.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(c, axis=(-3, -2, -1), keepdims=True), _EPS)
+    pz = jnp.sum(c, axis=(-3, -2)) / jnp.squeeze(total, (-3, -2))   # [..., Z]
+    mi_given_z = mutual_information(jnp.moveaxis(c, -1, -3))        # [..., Z]
+    return jnp.sum(pz * mi_given_z, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# categorical association coefficients (contingency matrix [..., R, C])
+# ---------------------------------------------------------------------------
+
+def cramer_index(counts: jax.Array) -> jax.Array:
+    """Cramér index φ²/min(R−1, C−1) — the reference's ``cramerIndex``
+    (util/ContingencyMatrix.java:86-123): mean-squared deviation of the joint
+    from the product of marginals, normalized by matrix dimension.
+
+    Computed as χ²/(N·min(R−1,C−1)) (Cramér's V squared).
+    """
+    c = counts.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(c, axis=(-2, -1), keepdims=True), _EPS)
+    pr = jnp.sum(c, axis=-1, keepdims=True) / n
+    pc = jnp.sum(c, axis=-2, keepdims=True) / n
+    p = c / n
+    e = pr * pc
+    chi2_over_n = jnp.sum(jnp.where(e > 0, (p - e) ** 2 / jnp.maximum(e, _EPS), 0.0), axis=(-2, -1))
+    r = counts.shape[-2]
+    k = counts.shape[-1]
+    dof = max(min(r - 1, k - 1), 1)
+    return chi2_over_n / dof
+
+
+def concentration_coefficient(counts: jax.Array) -> jax.Array:
+    """Goodman–Kruskal tau (Gini-based concentration coefficient) of the
+    column variable given the row variable — the reference's
+    ``concentrationCoeff`` (util/ContingencyMatrix.java:141-163):
+    (E[gini(col)] − E[gini(col|row)]) / gini(col)."""
+    c = counts.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(c, axis=(-2, -1), keepdims=True), _EPS)
+    p = c / n                                             # [..., R, C]
+    pr = jnp.sum(p, axis=-1)                              # [..., R]
+    pc = jnp.sum(p, axis=-2)                              # [..., C]
+    vy = 1.0 - jnp.sum(pc * pc, axis=-1)                  # gini of col marginal
+    within = jnp.sum(p * p, axis=-1) / jnp.maximum(pr, _EPS)   # Σ_c p(r,c)²/p(r)
+    vy_given_x = 1.0 - jnp.sum(within, axis=-1)
+    return (vy - vy_given_x) / jnp.maximum(vy, _EPS)
+
+
+def uncertainty_coefficient(counts: jax.Array) -> jax.Array:
+    """Theil's U of the column variable given the row variable — the
+    reference's ``uncertaintyCoeff`` (util/ContingencyMatrix.java:165-185):
+    (H(col) − H(col|row)) / H(col) = MI/H(col)."""
+    c = counts.astype(jnp.float32)
+    pc = normalize(jnp.sum(c, axis=-2), axis=-1)
+    hy = entropy(pc, axis=-1)
+    mi = mutual_information(c)
+    return mi / jnp.maximum(hy, _EPS)

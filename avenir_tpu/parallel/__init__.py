@@ -1,0 +1,15 @@
+from avenir_tpu.parallel.mesh import (
+    make_mesh,
+    data_sharding,
+    replicated,
+    pad_batch,
+    device_put_sharded_batch,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_sharding",
+    "replicated",
+    "pad_batch",
+    "device_put_sharded_batch",
+]

@@ -1,0 +1,86 @@
+"""Explicit-collective training steps (shard_map + psum).
+
+The auto-sharding path (sharded inputs under ``jax.jit``) already lets XLA
+insert the all-reduce; this module is the explicit SPMD spelling of the same
+programs — per-device partial aggregation (the reference's combiner) followed
+by ``lax.psum`` over the ``data`` mesh axis (the reference's shuffle), with
+the large count tensors optionally sharded over a ``model`` axis (the
+reference's key-space partitioners, explore/ClassPartitionGenerator.java:600-606).
+
+Used by sharded fit paths and by ``__graft_entry__.dryrun_multichip`` to
+validate multi-chip compilation on a virtual device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from avenir_tpu.ops.agg import one_hot as _onehot
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def sharded_nb_fit_step(mesh: Mesh, num_classes: int, num_bins: int, num_cont: int):
+    """Build a jitted SPMD Naive-Bayes sufficient-statistics step.
+
+    Inputs: codes [N, F] int32, labels [N] int32, cont [N, Fc] float32, all
+    sharded over ``data`` on axis 0. Outputs (replicated): [F, B, C] bin
+    counts, [C] class counts, ([C], [C,Fc], [C,Fc]) moments.
+    """
+
+    def step(codes, labels, cont):
+        oh_b = _onehot(codes, num_bins)                      # [n, F, B] local
+        oh_c = _onehot(labels, num_classes)                  # [n, C]
+        fbc = jnp.einsum("nfb,nc->fbc", oh_b, oh_c, precision="highest")
+        cc = jnp.sum(oh_c, axis=0)
+        s1 = jnp.einsum("nc,nf->cf", oh_c, cont, precision="highest")
+        s2 = jnp.einsum("nc,nf->cf", oh_c, cont * cont, precision="highest")
+        # the 'shuffle': one all-reduce over ICI per tensor
+        fbc = jax.lax.psum(fbc, "data")
+        cc = jax.lax.psum(cc, "data")
+        s1 = jax.lax.psum(s1, "data")
+        s2 = jax.lax.psum(s2, "data")
+        return fbc, cc, cc, s1, s2
+
+    wrapped = _shard_map(
+        step, mesh=mesh,
+        in_specs=(P("data", None), P("data"), P("data", None)),
+        out_specs=(P(), P(), P(), P(), P()),
+    )
+    return jax.jit(wrapped)
+
+
+def sharded_nb_fit_step_2d(mesh: Mesh, num_classes: int, num_bins: int):
+    """2-D (data × model) variant: batch sharded over ``data``; the [F, B, C]
+    count tensor computed and *kept sharded* over ``model`` on the feature
+    axis — the layout for high-cardinality tensors that must not be
+    replicated per device (SURVEY.md §7 'hard parts').
+
+    F must be divisible by the ``model`` axis size.
+    """
+
+    def step(codes, labels):
+        # codes arrive [n_local, F_local]: data-sharded rows, model-sharded features
+        oh_b = _onehot(codes, num_bins)
+        oh_c = _onehot(labels, num_classes)
+        fbc = jnp.einsum("nfb,nc->fbc", oh_b, oh_c, precision="highest")
+        fbc = jax.lax.psum(fbc, "data")      # reduce over data only; stays model-sharded
+        # labels are replicated over 'model', so reducing over 'data' alone
+        # already yields the global class counts on every model rank
+        cc = jax.lax.psum(jnp.sum(oh_c, axis=0), "data")
+        return fbc, cc
+
+    wrapped = _shard_map(
+        step, mesh=mesh,
+        in_specs=(P("data", "model"), P("data")),
+        out_specs=(P("model", None, None), P()),
+    )
+    return jax.jit(wrapped)

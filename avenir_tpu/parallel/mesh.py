@@ -1,0 +1,110 @@
+"""Device mesh + sharding helpers — the rebuild's cluster runtime.
+
+Where the reference scales by scheduling mapper/reducer JVMs over a Hadoop
+cluster (HDFS-block data parallelism + the MR shuffle as transport), this
+framework scales by laying arrays out over a `jax.sharding.Mesh` and letting
+XLA insert collectives over ICI (psum/all-gather), per the standard JAX SPMD
+recipe. Two axes:
+
+- ``data``  — batch/record axis: every estimator shards its record stream
+  here (the analog of records-across-mappers).
+- ``model`` — bin/feature axis for the large count tensors (feature-pair ×
+  class contingency tensors can reach O(F²·B²·C); sharding their feature axis
+  is the analog of the reference's key-space partitioners).
+
+Count-neutral padding: all count kernels in :mod:`avenir_tpu.ops.agg` encode
+via ``one_hot``, which maps index −1 to an all-zero row. Padding a batch with
+−1 codes/labels therefore changes no statistic, which is how ragged final
+chunks meet XLA's static-shape + even-sharding requirements.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_names: Tuple[str, ...] = ("data",),
+    shape: Optional[Tuple[int, ...]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh over available devices.
+
+    Default: 1-D ``data`` mesh over all devices. For 2-D requests without an
+    explicit shape, puts as many devices as possible on ``data`` and the rest
+    on trailing axes (factor 2 per extra axis when divisible).
+    """
+    devs = np.array(devices if devices is not None else jax.devices())
+    n = devs.size
+    if shape is None:
+        if len(axis_names) == 1:
+            shape = (n,)
+        else:
+            trailing = []
+            rem = n
+            for _ in axis_names[1:]:
+                f = 2 if rem % 2 == 0 and rem >= 2 else 1
+                trailing.append(f)
+                rem //= f
+            shape = (rem, *trailing)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    return Mesh(devs.reshape(shape), axis_names)
+
+
+def data_sharding(mesh: Mesh, rank: int, data_axis: str = "data") -> NamedSharding:
+    """NamedSharding that shards axis 0 over ``data`` and replicates the rest."""
+    return NamedSharding(mesh, P(data_axis, *([None] * (rank - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_batch(n_target: int, *arrays: np.ndarray, fill: int = -1):
+    """Pad axis 0 of each array up to ``n_target`` rows.
+
+    Integer arrays pad with ``fill`` (default −1 → count-neutral under
+    one-hot); float arrays pad with 0 (moment kernels pair them with −1
+    labels, so they are also neutral).
+    """
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        pad = n_target - a.shape[0]
+        if pad < 0:
+            raise ValueError(f"n_target {n_target} < batch {a.shape[0]}")
+        if pad == 0:
+            out.append(a)
+            continue
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        val = fill if np.issubdtype(a.dtype, np.integer) else 0
+        out.append(np.pad(a, widths, constant_values=val))
+    return out if len(out) > 1 else out[0]
+
+
+def padded_size(n: int, num_shards: int) -> int:
+    return ((n + num_shards - 1) // num_shards) * num_shards
+
+
+def device_put_sharded_batch(mesh: Mesh, *arrays, data_axis: str = "data"):
+    """Pad axis 0 to a multiple of the data-axis size and device_put with the
+    batch axis sharded over ``data``."""
+    nshard = mesh.shape[data_axis]
+    n = next(a.shape[0] for a in arrays if a is not None)
+    padded = pad_batch(padded_size(n, nshard), *arrays)
+    if len(arrays) == 1:
+        padded = [padded]
+    out = []
+    for a in padded:
+        if a is None:
+            out.append(None)
+        else:
+            out.append(jax.device_put(a, data_sharding(mesh, a.ndim, data_axis)))
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,3 @@
+from avenir_tpu.utils.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
+
+__all__ = ["ConfusionMatrix", "CostBasedArbitrator", "Counters"]

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Benchmark: Naive-Bayes + mutual-information pipeline throughput on TPU.
+
+The driver-defined primary metric (BASELINE.json): rows/sec/chip on the
+NaiveBayes+MI aggregation pipeline — the rebuild of the reference's
+hospital-readmission north-star workload (BayesianDistribution +
+MutualInformation MR jobs). Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rows/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is the speedup over a single-core numpy implementation of the
+same counts (the stand-in for the reference's per-record JVM mapper loop,
+measured on a subsample and scaled), since the reference publishes no numbers
+(BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops import agg
+
+
+def make_data(n_rows: int, n_feat: int, n_bins: int, n_classes: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, n_bins, size=(n_rows, n_feat), dtype=np.int32)
+    labels = rng.integers(0, n_classes, size=n_rows, dtype=np.int32)
+    return codes, labels
+
+
+def numpy_reference_rows_per_sec(codes, labels, n_classes, n_bins):
+    """Single-core numpy equivalent of the NB+MI count pass (per-record cost model
+    of the reference's mapper+reducer). Computes the SAME work as the TPU
+    pipeline (all feature pairs) so vs_baseline compares like for like."""
+    n, f = codes.shape
+    pairs = [(i, j) for i in range(f) for j in range(i + 1, f)]
+    t0 = time.perf_counter()
+    # NB: class-conditional counts
+    for fi in range(f):
+        np.add.at(np.zeros((n_bins, n_classes)), (codes[:, fi], labels), 1)
+    # MI: pairwise joint counts
+    for i, j in pairs:
+        np.add.at(np.zeros((n_bins, n_bins)), (codes[:, i], codes[:, j]), 1)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    n_classes, n_bins, n_feat = 2, 12, 11      # hosp_readmit-shaped workload
+    chunk = 2_000_000
+    n_chunks = 8
+    codes, labels = make_data(chunk, n_feat, n_bins, n_classes)
+    pair_idx = np.array([(i, j) for i in range(n_feat) for j in range(i + 1, n_feat)], np.int32)
+    ci, cj = pair_idx[:, 0], pair_idx[:, 1]
+
+    dcodes = jnp.asarray(codes)
+    dlabels = jnp.asarray(labels)
+
+    def pipeline_step(c, l):
+        fbc = agg.feature_class_counts(c, l, n_classes, n_bins)
+        pc = agg.pair_class_counts(c[:, ci], c[:, cj], l, n_classes, n_bins)
+        return fbc, pc
+
+    # warmup/compile
+    out = pipeline_step(dcodes, dlabels)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        out = pipeline_step(dcodes, dlabels)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    rows_per_sec = n_chunks * chunk / dt
+
+    # numpy single-core baseline on a subsample
+    sub = 200_000
+    np_rps = numpy_reference_rows_per_sec(codes[:sub], labels[:sub], n_classes, n_bins)
+
+    print(json.dumps({
+        "metric": "nb_mi_pipeline_throughput",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(rows_per_sec / np_rps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

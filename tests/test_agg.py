@@ -1,0 +1,154 @@
+"""Aggregation kernels vs numpy oracles; sharded execution; info stats."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from avenir_tpu.ops import agg, info
+from avenir_tpu.parallel import mesh as pmesh
+
+
+def _np_feature_class_counts(codes, labels, C, B):
+    F = codes.shape[1]
+    out = np.zeros((F, B, C), np.int64)
+    for n in range(codes.shape[0]):
+        for f in range(F):
+            if codes[n, f] >= 0 and labels[n] >= 0:
+                out[f, codes[n, f], labels[n]] += 1
+    return out
+
+
+def test_feature_class_counts_oracle(rng):
+    codes = rng.integers(0, 6, size=(500, 4)).astype(np.int32)
+    labels = rng.integers(0, 3, size=500).astype(np.int32)
+    got = np.asarray(agg.feature_class_counts(jnp.asarray(codes), jnp.asarray(labels), 3, 6))
+    np.testing.assert_array_equal(got, _np_feature_class_counts(codes, labels, 3, 6))
+    # class + feature marginals agree
+    np.testing.assert_array_equal(
+        np.asarray(agg.class_counts(jnp.asarray(labels), 3)), got.sum(axis=(0, 1)) // 4)
+    np.testing.assert_array_equal(
+        np.asarray(agg.feature_counts(jnp.asarray(codes), 6)), got.sum(axis=2))
+
+
+def test_negative_index_is_count_neutral(rng):
+    """-1 padding must not contribute to any count (one_hot drops it)."""
+    codes = rng.integers(0, 5, size=(100, 3)).astype(np.int32)
+    labels = rng.integers(0, 2, size=100).astype(np.int32)
+    base = np.asarray(agg.feature_class_counts(jnp.asarray(codes), jnp.asarray(labels), 2, 5))
+    padded_codes, padded_labels = pmesh.pad_batch(128, codes, labels)
+    assert padded_codes.shape == (128, 3) and (padded_codes[100:] == -1).all()
+    padded = np.asarray(agg.feature_class_counts(jnp.asarray(padded_codes), jnp.asarray(padded_labels), 2, 5))
+    np.testing.assert_array_equal(base, padded)
+
+
+def test_pair_counts_oracle(rng):
+    a = rng.integers(0, 4, size=(300, 2)).astype(np.int32)
+    b = rng.integers(0, 4, size=(300, 2)).astype(np.int32)
+    got = np.asarray(agg.pair_counts(jnp.asarray(a), jnp.asarray(b), 4))
+    for p in range(2):
+        expect = np.zeros((4, 4), np.int64)
+        for n in range(300):
+            expect[a[n, p], b[n, p]] += 1
+        np.testing.assert_array_equal(got[p], expect)
+
+
+def test_class_moments_oracle(rng):
+    vals = rng.normal(size=(400, 3)).astype(np.float32)
+    labels = rng.integers(0, 2, size=400).astype(np.int32)
+    cnt, s1, s2 = agg.class_moments(jnp.asarray(vals), jnp.asarray(labels), 2)
+    for c in range(2):
+        m = labels == c
+        np.testing.assert_allclose(np.asarray(cnt)[c], m.sum(), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1)[c], vals[m].sum(0), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(s2)[c], (vals[m] ** 2).sum(0), rtol=1e-4)
+
+
+def test_transition_counts(rng):
+    a = rng.integers(0, 3, size=200).astype(np.int32)
+    b = rng.integers(0, 5, size=200).astype(np.int32)
+    got = np.asarray(agg.transition_counts(jnp.asarray(a), jnp.asarray(b), 3, 5))
+    expect = np.zeros((3, 5), np.int64)
+    for x, y in zip(a, b):
+        expect[x, y] += 1
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_sharded_counts_match_single_device(rng):
+    """Counts under a sharded jit over the 8-device CPU mesh == local counts.
+
+    This is the MR-shuffle replacement: per-device partial einsum (the
+    'combiner') + XLA-inserted all-reduce (the 'shuffle').
+    """
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    m = pmesh.make_mesh(("data",))
+    codes = rng.integers(0, 7, size=(1000, 5)).astype(np.int32)
+    labels = rng.integers(0, 3, size=1000).astype(np.int32)
+    local = np.asarray(agg.feature_class_counts(jnp.asarray(codes), jnp.asarray(labels), 3, 7))
+    sc, sl = pmesh.device_put_sharded_batch(m, codes, labels)
+    sharded = np.asarray(agg.feature_class_counts(sc, sl, 3, 7))
+    np.testing.assert_array_equal(local, sharded)
+
+
+def test_entropy_gini():
+    p = jnp.array([0.5, 0.5])
+    np.testing.assert_allclose(float(info.entropy(p)), np.log(2), rtol=1e-6)
+    np.testing.assert_allclose(float(info.gini(p)), 0.5, rtol=1e-6)
+    counts = jnp.array([2.0, 2.0, 0.0])
+    np.testing.assert_allclose(float(info.entropy_from_counts(counts)), np.log(2), rtol=1e-6)
+
+
+def test_mutual_information_independent_and_dependent():
+    # independent: uniform 2x2 grid -> MI 0
+    indep = jnp.array([[25.0, 25.0], [25.0, 25.0]])
+    np.testing.assert_allclose(float(info.mutual_information(indep)), 0.0, atol=1e-6)
+    # perfectly dependent -> MI = log 2
+    dep = jnp.array([[50.0, 0.0], [0.0, 50.0]])
+    np.testing.assert_allclose(float(info.mutual_information(dep)), np.log(2), rtol=1e-5)
+    # joint entropy of uniform 2x2 = log 4
+    np.testing.assert_allclose(float(info.joint_entropy(indep)), np.log(4), rtol=1e-6)
+
+
+def test_mutual_information_vs_sklearn(rng):
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    x = rng.integers(0, 4, size=2000)
+    y = (x + rng.integers(0, 2, size=2000)) % 4
+    joint = np.zeros((4, 4))
+    for a, b in zip(x, y):
+        joint[a, b] += 1
+    got = float(info.mutual_information(jnp.asarray(joint)))
+    expect = sklearn_metrics.mutual_info_score(x, y)
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_cramer_index_vs_oracle(rng):
+    scipy_stats = pytest.importorskip("scipy.stats")
+    joint = rng.integers(1, 50, size=(3, 4)).astype(np.float64)
+    got = float(info.cramer_index(jnp.asarray(joint)))
+    chi2 = scipy_stats.chi2_contingency(joint, correction=False)[0]
+    expect = chi2 / (joint.sum() * min(3 - 1, 4 - 1))   # Cramér's V²
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_uncertainty_and_concentration_bounds(rng):
+    joint = rng.integers(1, 30, size=(4, 3)).astype(np.float64)
+    u = float(info.uncertainty_coefficient(jnp.asarray(joint)))
+    t = float(info.concentration_coefficient(jnp.asarray(joint)))
+    assert 0.0 <= u <= 1.0
+    assert 0.0 <= t <= 1.0
+    # perfect association -> both 1
+    perfect = jnp.eye(3) * 10
+    np.testing.assert_allclose(float(info.uncertainty_coefficient(perfect)), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(float(info.concentration_coefficient(perfect)), 1.0, rtol=1e-5)
+
+
+def test_conditional_mutual_information():
+    # X,Y independent given Z but dependent marginally
+    # counts[x, y, z]: within each z slice, independent uniform
+    c = np.zeros((2, 2, 2))
+    c[:, :, 0] = [[20, 5], [5, 20]]
+    c[:, :, 1] = [[5, 20], [20, 5]]
+    cmi = float(info.conditional_mutual_information(jnp.asarray(c)))
+    # per-slice MI is equal; CMI should equal slice MI
+    mi0 = float(info.mutual_information(jnp.asarray(c[:, :, 0])))
+    np.testing.assert_allclose(cmi, mi0, rtol=1e-5)

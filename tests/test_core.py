@@ -1,0 +1,151 @@
+"""Core data layer: schema parsing, config, CSV IO, encoding."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.csv_io import read_csv_string, iter_csv_chunks, write_csv
+from avenir_tpu.core.encoding import DatasetEncoder
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+
+
+def test_schema_roles_churn():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    assert schema.id_field.name == "id"
+    assert schema.class_field.name == "status"          # neither id nor feature
+    assert [f.name for f in schema.feature_fields] == [
+        "minUsed", "dataUsed", "CSCalls", "payment", "acctAge"]
+    assert all(f.is_binned for f in schema.feature_fields)
+    assert schema.field_by_ordinal(1).cardinality == ["low", "med", "high", "overage"]
+
+
+def test_schema_numeric_binning_flags():
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "age", "ordinal": 1, "dataType": "int", "feature": True, "bucketWidth": 10},
+        {"name": "income", "ordinal": 2, "dataType": "double", "feature": True},
+        {"name": "label", "ordinal": 3, "dataType": "categorical", "classAttr": True,
+         "cardinality": ["0", "1"]},
+    ]})
+    age, income = schema.field_by_name("age"), schema.field_by_name("income")
+    assert age.is_binned and not age.is_continuous
+    assert income.is_continuous and not income.is_binned
+    assert schema.class_field.name == "label"
+    assert [f.name for f in schema.binned_feature_fields] == ["age"]
+    assert [f.name for f in schema.continuous_feature_fields] == ["income"]
+
+
+def test_schema_roundtrip():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    again = FeatureSchema.from_json(schema.to_json())
+    assert repr(again) == repr(schema)
+
+
+def test_job_config():
+    cfg = JobConfig.from_lines([
+        "# comment",
+        "field.delim.regex=,",
+        "avenir.top.match.count = 5",
+        "kernel.function.type=gaussian",
+        "class.values=pos,neg",
+        "threshold=0.75",
+        "debug.on=true",
+        "",
+    ])
+    assert cfg.get("kernel.function.type") == "gaussian"
+    assert cfg.get_int("top.match.count") == 5          # prefix-insensitive
+    assert cfg.get_int("avenir.top.match.count") == 5
+    assert cfg.get_float("threshold") == 0.75
+    assert cfg.get_list("class.values") == ["pos", "neg"]
+    assert cfg.debug_on
+    assert cfg.get("missing", "dflt") == "dflt"
+    assert cfg.get_int("missing") is None
+    # Java Properties first-separator rule: ':' before '=' wins
+    cfg2 = JobConfig.from_lines(["conn:retries=3", "url=redis://h:6379"])
+    assert cfg2.get("conn") == "retries=3"
+    assert cfg2.get("url") == "redis://h:6379"
+
+
+def test_csv_roundtrip(tmp_path):
+    rows = generate_churn(50, seed=1)
+    path = tmp_path / "churn.csv"
+    write_csv(str(path), rows.tolist())
+    back = read_csv_string(path.read_text())
+    assert back.shape == rows.shape
+    assert (back == rows).all()
+    chunks = list(iter_csv_chunks(str(path), chunk_rows=20))
+    assert [c.shape[0] for c in chunks] == [20, 20, 10]
+
+
+def test_csv_ragged_raises():
+    with pytest.raises(ValueError):
+        read_csv_string("a,b,c\na,b\n")
+
+
+def test_encoder_churn():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(200, seed=2)
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    assert ds.codes.shape == (200, 5)
+    assert ds.cont.shape == (200, 0)
+    assert ds.labels.shape == (200,)
+    # schema-declared vocab + 1 OOV slot
+    assert ds.n_bins.tolist() == [5, 4, 4, 4, 6]
+    assert ds.class_values == ["open", "closed"]
+    # codes follow schema cardinality order
+    i = rows[:, 1].tolist().index("overage") if "overage" in rows[:, 1].tolist() else None
+    if i is not None:
+        assert ds.codes[i, 0] == 3
+    # OOV maps to the reserved last bin
+    rows2 = rows.copy()
+    rows2[0, 1] = "NEVER_SEEN"
+    ds2 = enc.transform(rows2)
+    assert ds2.codes[0, 0] == ds.n_bins[0] - 1
+    # bin label round trip
+    assert enc.bin_label(0, 3) == "overage"
+    assert enc.bin_code(0, "overage") == 3
+
+
+def test_encoder_numeric_binning():
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "x", "ordinal": 0, "dataType": "int", "feature": True, "bucketWidth": 10},
+        {"name": "y", "ordinal": 1, "dataType": "double", "feature": True},
+        {"name": "cls", "ordinal": 2, "dataType": "categorical", "classAttr": True,
+         "cardinality": ["a", "b"]},
+    ]})
+    rows = np.array([
+        ["5", "1.5", "a"],
+        ["15", "2.5", "b"],
+        ["-12", "3.5", "a"],
+        ["29", "0.5", "b"],
+    ], dtype=object)
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    # bins: floor(v/10) in {-2, 0, 1, 2} -> offset -2 -> codes {0, 2, 3, 4}
+    assert ds.codes[:, 0].tolist() == [2, 3, 0, 4]
+    assert ds.n_bins.tolist() == [5]
+    assert enc.bin_label(0, 2) == "0"       # serde label is the raw bin id
+    np.testing.assert_allclose(ds.cont[:, 0], [1.5, 2.5, 3.5, 0.5])
+    assert ds.labels.tolist() == [0, 1, 0, 1]
+    # transform clips unseen out-of-range bins into the fitted range
+    ds2 = enc.transform(np.array([["999", "1.0", "a"]], dtype=object))
+    assert ds2.codes[0, 0] == 4
+
+
+def test_encoder_streaming(tmp_path):
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(100, seed=3)
+    path = tmp_path / "c.csv"
+    write_csv(str(path), rows.tolist())
+    enc = DatasetEncoder(schema)
+    enc.fit(rows)
+    chunks = list(enc.iter_encoded(str(path), chunk_rows=32))
+    assert [c.num_rows for c in chunks] == [32, 32, 32, 4]
+    full = enc.transform(rows)
+    np.testing.assert_array_equal(np.concatenate([c.codes for c in chunks]), full.codes)
+    np.testing.assert_array_equal(np.concatenate([c.labels for c in chunks]), full.labels)

@@ -1,0 +1,144 @@
+"""Naive Bayes: oracle equivalence (sklearn), planted-structure recovery,
+model-file serde round trip, chunked == whole-batch fit, arbitration."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.encoding import DatasetEncoder
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.models.naive_bayes import (
+    NaiveBayes, model_from_lines, model_to_lines, nb_log_scores,
+)
+
+
+@pytest.fixture(scope="module")
+def churn():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(4000, seed=7)
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    return schema, rows, enc, ds
+
+
+def test_fit_counts_exact(churn):
+    _, rows, enc, ds = churn
+    model = NaiveBayes().fit(ds)
+    # class counts match raw data
+    closed = (rows[:, 6] == "closed").sum()
+    assert model.class_counts[ds.class_values.index("closed")] == closed
+    # one feature/bin count cross-check: minUsed == overage among closed
+    overage_closed = ((rows[:, 1] == "overage") & (rows[:, 6] == "closed")).sum()
+    ci = ds.class_values.index("closed")
+    assert model.bin_counts[0, 3, ci] == overage_closed
+
+
+def test_chunked_fit_equals_whole(churn):
+    _, _, _, ds = churn
+    whole = NaiveBayes().fit(ds)
+    chunks = [ds.slice(i, min(i + 512, ds.num_rows)) for i in range(0, ds.num_rows, 512)]
+    chunked = NaiveBayes().fit(iter(chunks))
+    np.testing.assert_array_equal(whole.bin_counts, chunked.bin_counts)
+    np.testing.assert_array_equal(whole.class_counts, chunked.class_counts)
+
+
+def test_vs_sklearn_categorical_nb(churn):
+    sklearn_nb = pytest.importorskip("sklearn.naive_bayes")
+    _, _, _, ds = churn
+    model = NaiveBayes(laplace=1.0).fit(ds)
+    nb = NaiveBayes()
+    res = nb.predict(model, ds)
+    skm = sklearn_nb.CategoricalNB(alpha=1.0, min_categories=ds.n_bins.tolist())
+    skm.fit(ds.codes, ds.labels)
+    sk_probs = skm.predict_proba(ds.codes)
+    np.testing.assert_allclose(res.probs, sk_probs, atol=2e-4)
+    agree = (res.predicted == skm.predict(ds.codes)).mean()
+    assert agree == 1.0
+
+
+def test_gaussian_nb_vs_sklearn(rng):
+    sklearn_nb = pytest.importorskip("sklearn.naive_bayes")
+    from avenir_tpu.core.encoding import EncodedDataset
+    n = 1000
+    labels = rng.integers(0, 2, size=n).astype(np.int32)
+    x = rng.normal(size=(n, 3)).astype(np.float32) + labels[:, None] * 1.5
+    ds = EncodedDataset(
+        codes=np.zeros((n, 0), np.int32), cont=x, labels=labels,
+        n_bins=np.zeros(0, np.int32), class_values=["a", "b"])
+    model = NaiveBayes().fit(ds)
+    res = NaiveBayes().predict(model, ds)
+    skm = sklearn_nb.GaussianNB()
+    skm.fit(x, labels)
+    # GaussianNB uses biased variance; ours unbiased -> tiny prob differences
+    np.testing.assert_allclose(res.probs, skm.predict_proba(x), atol=5e-3)
+    assert (res.predicted == skm.predict(x)).mean() > 0.999
+
+
+def test_recovers_planted_churn_drivers(churn):
+    """NB posteriors must reflect usage.rb's planted multipliers:
+    P(closed | overage) > P(closed | med minutes), etc."""
+    _, _, enc, ds = churn
+    model = NaiveBayes().fit(ds)
+    ci = ds.class_values.index("closed")
+    post = model.bin_counts[..., ci] / np.maximum(model.bin_counts.sum(-1), 1)
+    # minUsed: closed-rate(overage) > closed-rate(med)
+    assert post[0, 3] > post[0, 1]
+    # CSCalls: closed-rate(high) > closed-rate(low)
+    hi, lo = enc.bin_code(2, "high"), enc.bin_code(2, "low")
+    assert post[2, hi] > post[2, lo]
+
+
+def test_validation_and_cost_arbitration(churn):
+    _, _, _, ds = churn
+    model = NaiveBayes().fit(ds)
+    nb = NaiveBayes()
+    res = nb.predict(model, ds, validate=True, pos_class="closed",
+                     ambiguity_threshold=0.2)
+    assert res.confusion is not None
+    acc = res.counters.get("Validation", "accuracy")
+    assert 55 <= acc <= 100          # better than majority-class-only noise
+    assert res.ambiguous is not None and res.ambiguous.dtype == bool
+    # heavily penalize missing 'closed' -> more closed predictions
+    cost = np.array([[0.0, 1.0], [10.0, 0.0]])  # actual x predicted
+    res_cost = nb.predict(model, ds, cost=cost)
+    ci = ds.class_values.index("closed")
+    assert (res_cost.predicted == ci).sum() > (res.predicted == ci).sum()
+
+
+def test_model_serde_roundtrip(churn):
+    _, _, enc, ds = churn
+    model = NaiveBayes().fit(ds)
+    lines = model_to_lines(model, enc)
+    # reference row shapes: classVal,ord,bin,count / classVal,,,count / ,ord,bin,count
+    assert any(l.split(",")[0] == "" for l in lines)            # feature priors
+    assert any(l.split(",")[1] == "" and l.split(",")[2] == "" for l in lines)  # class priors
+    back = model_from_lines(lines, enc)
+    np.testing.assert_array_equal(back.bin_counts, model.bin_counts)
+    np.testing.assert_array_equal(back.class_counts, model.class_counts)
+    res1 = NaiveBayes().predict(model, ds)
+    res2 = NaiveBayes().predict(back, ds)
+    np.testing.assert_allclose(res1.probs, res2.probs, atol=1e-6)
+
+
+def test_model_serde_continuous_roundtrip(rng):
+    from avenir_tpu.core.schema import FeatureSchema
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "x", "ordinal": 0, "dataType": "double", "feature": True},
+        {"name": "cls", "ordinal": 1, "dataType": "categorical", "classAttr": True,
+         "cardinality": ["a", "b"]},
+    ]})
+    rows = np.empty((500, 2), object)
+    labels = rng.integers(0, 2, size=500)
+    rows[:, 0] = (rng.normal(size=500) + labels * 2.0).astype(str).astype(object)
+    rows[:, 1] = np.where(labels == 1, "b", "a").astype(object)
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    model = NaiveBayes().fit(ds)
+    back = model_from_lines(model_to_lines(model, enc), enc)
+    m1, s1 = model.cont_stats
+    m2, s2 = back.cont_stats
+    np.testing.assert_allclose(m1, m2, rtol=1e-6)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5)
+    res1 = NaiveBayes().predict(model, ds)
+    res2 = NaiveBayes().predict(back, ds)
+    assert (res1.predicted == res2.predicted).all()
