@@ -1,3 +1,22 @@
 from avenir_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
+from avenir_tpu.models.mutual_info import MutualInformation, MutualInfoResult, score_features
+from avenir_tpu.models.correlation import (
+    CategoricalCorrelation,
+    CramerCorrelation,
+    HeterogeneityReductionCorrelation,
+)
+from avenir_tpu.models.samplers import bagging_sample, undersample, StreamingUnderSampler
 
-__all__ = ["NaiveBayes", "NaiveBayesModel"]
+__all__ = [
+    "NaiveBayes",
+    "NaiveBayesModel",
+    "MutualInformation",
+    "MutualInfoResult",
+    "score_features",
+    "CategoricalCorrelation",
+    "CramerCorrelation",
+    "HeterogeneityReductionCorrelation",
+    "bagging_sample",
+    "undersample",
+    "StreamingUnderSampler",
+]

@@ -1,0 +1,109 @@
+"""Class-balancing and bootstrap samplers.
+
+Capability parity with ``explore/BaggingSampler.java`` (map-only bootstrap
+sampling with replacement per in-memory batch of ``batch.size`` rows
+:100-122) and ``explore/UnderSamplingBalancer.java`` (streaming majority-class
+undersampler: bootstrap the class distribution from the first
+``distr.batch.size`` rows, then always emit minority rows and emit majority
+rows with probability minCount/count :92-164).
+
+TPU design: sampling decisions are vectorized jax.random kernels over whole
+batches (index draws / keep-masks) rather than per-record RNG calls; the
+streaming variant keeps the running class counts on host exactly like the
+reference's streaming estimate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Iterator, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avenir_tpu.core.encoding import EncodedDataset
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k"))
+def bootstrap_indices(key: jax.Array, n: int, k: Optional[int] = None) -> jax.Array:
+    """k (default n) indices drawn uniformly with replacement from [0, n)."""
+    return jax.random.randint(key, ((k if k is not None else n),), 0, n)
+
+
+def bagging_sample(key: jax.Array, ds: EncodedDataset, k: Optional[int] = None) -> EncodedDataset:
+    """Bootstrap resample of a batch (with replacement), preserving all columns."""
+    idx = np.asarray(bootstrap_indices(key, ds.num_rows, k))
+    return EncodedDataset(
+        codes=ds.codes[idx], cont=ds.cont[idx],
+        labels=None if ds.labels is None else ds.labels[idx],
+        ids=None if ds.ids is None else ds.ids[idx],
+        n_bins=ds.n_bins, class_values=ds.class_values,
+        binned_ordinals=ds.binned_ordinals, cont_ordinals=ds.cont_ordinals,
+    )
+
+
+@jax.jit
+def undersample_mask(key: jax.Array, labels: jax.Array, class_counts: jax.Array) -> jax.Array:
+    """Keep-mask balancing classes: minority rows always kept; class c rows
+    kept with probability min_count / count_c (the reference's acceptance
+    rule)."""
+    counts = jnp.maximum(class_counts.astype(jnp.float32), 1.0)
+    min_count = jnp.min(jnp.where(class_counts > 0, counts, jnp.inf))
+    keep_prob = min_count / counts                      # [C]
+    u = jax.random.uniform(key, labels.shape)
+    return u < keep_prob[labels]
+
+
+def undersample(key: jax.Array, ds: EncodedDataset,
+                class_counts: Optional[np.ndarray] = None) -> EncodedDataset:
+    """Balanced subsample of a batch. ``class_counts`` defaults to the batch's
+    own counts (whole-dataset mode); pass running counts for streaming."""
+    if ds.labels is None:
+        raise ValueError("undersampling requires labels")
+    if class_counts is None:
+        class_counts = np.bincount(ds.labels, minlength=ds.num_classes)
+    mask = np.asarray(undersample_mask(key, jnp.asarray(ds.labels),
+                                       jnp.asarray(class_counts)))
+    idx = np.flatnonzero(mask)
+    return EncodedDataset(
+        codes=ds.codes[idx], cont=ds.cont[idx], labels=ds.labels[idx],
+        ids=None if ds.ids is None else ds.ids[idx],
+        n_bins=ds.n_bins, class_values=ds.class_values,
+        binned_ordinals=ds.binned_ordinals, cont_ordinals=ds.cont_ordinals,
+    )
+
+
+class StreamingUnderSampler:
+    """Streaming variant: like the reference, the class distribution is
+    estimated from the rows seen so far (first batches are buffered until
+    ``bootstrap_rows`` rows have arrived, then flushed and sampling begins)."""
+
+    def __init__(self, key: jax.Array, bootstrap_rows: int = 10_000):
+        self.key = key
+        self.bootstrap_rows = bootstrap_rows
+        self._counts: Optional[np.ndarray] = None
+        self._buffered = 0
+
+    def process(self, chunks: Iterable[EncodedDataset]) -> Iterator[EncodedDataset]:
+        pending = []
+        for ds in chunks:
+            if ds.labels is None:
+                raise ValueError("undersampling requires labels")
+            batch_counts = np.bincount(ds.labels, minlength=ds.num_classes)
+            self._counts = batch_counts if self._counts is None else self._counts + batch_counts
+            if self._buffered < self.bootstrap_rows:
+                pending.append(ds)
+                self._buffered += ds.num_rows
+                if self._buffered >= self.bootstrap_rows:
+                    for p in pending:
+                        yield self._sample(p)
+                    pending = []
+            else:
+                yield self._sample(ds)
+        for p in pending:  # stream ended before bootstrap filled
+            yield self._sample(p)
+
+    def _sample(self, ds: EncodedDataset) -> EncodedDataset:
+        self.key, sub = jax.random.split(self.key)
+        return undersample(sub, ds, self._counts)

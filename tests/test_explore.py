@@ -1,0 +1,184 @@
+"""Explore suite: MI engine vs sklearn oracle, planted-structure recovery,
+feature-selection algorithms, correlation jobs, samplers."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from avenir_tpu.core.encoding import DatasetEncoder
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.datagen.hosp_readmit import HOSP_SCHEMA_JSON, generate_hosp_readmit
+from avenir_tpu.models import correlation as corr
+from avenir_tpu.models import mutual_info as mi
+from avenir_tpu.models import samplers
+
+
+@pytest.fixture(scope="module")
+def hosp():
+    schema = FeatureSchema.from_json(HOSP_SCHEMA_JSON)
+    rows = generate_hosp_readmit(20000, seed=3)   # tutorial-sized dataset
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    names = [f.name for f in schema.binned_feature_fields]
+    return schema, rows, enc, ds, names
+
+
+@pytest.fixture(scope="module")
+def hosp_result(hosp):
+    _, _, _, ds, names = hosp
+    return mi.MutualInformation(pair_chunk=16).fit(ds, feature_names=names)
+
+
+def test_mi_matches_sklearn(hosp, hosp_result):
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    _, _, _, ds, _ = hosp
+    res = hosp_result
+    for f in range(ds.num_binned):
+        expect = sklearn_metrics.mutual_info_score(ds.codes[:, f], ds.labels)
+        np.testing.assert_allclose(res.feature_class_mi[f], expect, rtol=1e-4, atol=1e-7)
+    # pair MI spot checks
+    pos = res.pair_pos()
+    for (i, j) in [(0, 1), (3, 4), (8, 9)]:
+        expect = sklearn_metrics.mutual_info_score(ds.codes[:, i], ds.codes[:, j])
+        np.testing.assert_allclose(res.feature_pair_mi[pos[(i, j)]], expect, rtol=1e-4, atol=1e-7)
+    # joint (fi,fj);class MI spot check via combined code
+    i, j = 4, 5
+    combined = ds.codes[:, i].astype(np.int64) * ds.max_bins + ds.codes[:, j]
+    expect = sklearn_metrics.mutual_info_score(combined, ds.labels)
+    np.testing.assert_allclose(res.pair_class_mi[pos[(i, j)]], expect, rtol=1e-4, atol=1e-7)
+
+
+def test_mi_identities(hosp_result):
+    res = hosp_result
+    pos = res.pair_pos()
+    for (i, j), k in list(pos.items())[:10]:
+        # chain-rule bound: I((fi,fj);c) >= max(I(fi;c), I(fj;c)) - tolerance
+        assert res.pair_class_mi[k] >= max(res.feature_class_mi[i], res.feature_class_mi[j]) - 1e-5
+        # nonnegativity
+        assert res.feature_pair_mi[k] >= -1e-7
+        assert res.feature_pair_class_cond_mi[k] >= -1e-6
+
+
+def test_mi_recovers_planted_drivers(hosp, hosp_result):
+    """hosp_readmit.rb's strongest drivers must rank above the weakest."""
+    _, _, _, _, names = hosp
+    res = hosp_result
+    rank = {names[f]: r for r, (f, _) in enumerate(mi.mim_score(res))}
+    # age (+10/+5/+3), familyStatus (+9), followUp (+8) are planted strong;
+    # weight and height only act through a weak interaction; exercise is weak
+    for strong in ("age", "familyStatus", "followUp"):
+        assert rank[strong] < rank["height"], (strong, rank)
+        assert rank[strong] < rank["exercise"], (strong, rank)
+
+
+def test_feature_selection_algorithms(hosp_result):
+    res = hosp_result
+    f = res.num_features
+    for algo in ("mim", "mifs", "jmi", "disr", "mrmr"):
+        out = mi.score_features(res, algo)
+        chosen = [x for x, _ in out]
+        assert sorted(chosen) == list(range(f)), algo      # permutation
+    # property-name aliases work
+    out2 = mi.score_features(res, "min.redundancy.max.relevance")
+    assert [x for x, _ in out2] == [x for x, _ in mi.mrmr_score(res)]
+    with pytest.raises(ValueError):
+        mi.score_features(res, "nope")
+    # mifs with huge redundancy factor must differ from mim ordering eventually
+    mim_order = [x for x, _ in mi.mim_score(res)]
+    mifs_order = [x for x, _ in mi.mifs_score(res, redundancy_factor=50.0)]
+    assert mim_order[0] == mifs_order[0]
+
+
+def test_mi_chunked_equals_whole(hosp):
+    _, _, _, ds, names = hosp
+    whole = mi.MutualInformation(pair_chunk=7).fit(ds, feature_names=names)
+    parts = [ds.slice(i, min(i + 4096, ds.num_rows)) for i in range(0, ds.num_rows, 4096)]
+    chunked = mi.MutualInformation(pair_chunk=64).fit(iter(parts), feature_names=names)
+    np.testing.assert_array_equal(whole.feature_class_counts, chunked.feature_class_counts)
+    np.testing.assert_array_equal(whole.pair_class_counts, chunked.pair_class_counts)
+    np.testing.assert_allclose(whole.feature_class_mi, chunked.feature_class_mi, rtol=1e-6)
+
+
+def test_mi_output_lines(hosp_result):
+    lines = hosp_result.to_lines()
+    kinds = {l.split(",")[0] for l in lines}
+    assert kinds == {"featureClassMI", "featurePairMI", "featurePairClassMI",
+                     "featurePairClassCondMI"}
+
+
+def test_cramer_correlation_churn():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(8000, seed=4)
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    names = [f.name for f in schema.binned_feature_fields]
+    job = corr.CramerCorrelation()
+    res = job.fit(ds, against_class=True, feature_names=names)
+    assert res.algorithm == "cramerIndex"
+    by_name = {a: v for (a, _), v in zip(res.pair_names, res.stat)}
+    # usage.rb plants minUsed/dataUsed/CSCalls as churn drivers; acctAge is weak
+    assert by_name["minUsed"] > by_name["acctAge"]
+    assert by_name["dataUsed"] > by_name["acctAge"]
+    assert all(0 <= v <= 1 + 1e-6 for v in by_name.values())
+    # feature-feature mode yields all i<j pairs
+    res2 = job.fit(ds, feature_names=names)
+    assert len(res2.pairs) == 5 * 4 // 2
+    assert res2.to_lines()[0].count(",") == 2
+
+
+def test_heterogeneity_correlation_consistency():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(6000, seed=5)
+    ds = DatasetEncoder(schema).fit_transform(rows)
+    names = [f.name for f in schema.binned_feature_fields]
+    conc = corr.HeterogeneityReductionCorrelation("concentrationCoeff").fit(
+        ds, against_class=True, feature_names=names)
+    unc = corr.HeterogeneityReductionCorrelation("uncertaintyCoeff").fit(
+        ds, against_class=True, feature_names=names)
+    # both rank the planted strong driver above the weak one
+    c = {a: v for (a, _), v in zip(conc.pair_names, conc.stat)}
+    u = {a: v for (a, _), v in zip(unc.pair_names, unc.stat)}
+    assert c["minUsed"] > c["acctAge"]
+    assert u["minUsed"] > u["acctAge"]
+    with pytest.raises(ValueError):
+        corr.CategoricalCorrelation("bogus")
+
+
+def test_bagging_sampler(rng):
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(2000, seed=6)
+    ds = DatasetEncoder(schema).fit_transform(rows)
+    out = samplers.bagging_sample(jax.random.PRNGKey(0), ds)
+    assert out.num_rows == ds.num_rows
+    # with replacement: expect ~1/e of rows never drawn
+    drawn = len(set(out.ids.tolist()))
+    assert 0.55 < drawn / ds.num_rows < 0.72
+    # half-size bootstrap
+    half = samplers.bagging_sample(jax.random.PRNGKey(1), ds, k=500)
+    assert half.num_rows == 500
+
+
+def test_undersample_balances():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(12000, seed=7)
+    ds = DatasetEncoder(schema).fit_transform(rows)
+    before = np.bincount(ds.labels, minlength=2)
+    out = samplers.undersample(jax.random.PRNGKey(2), ds)
+    after = np.bincount(out.labels, minlength=2)
+    ratio = after.max() / max(after.min(), 1)
+    assert ratio < 1.15, (before, after)                 # balanced within 15%
+    assert after.min() > 0.8 * before.min()              # minority mostly kept
+
+
+def test_streaming_undersampler():
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(10000, seed=8)
+    ds = DatasetEncoder(schema).fit_transform(rows)
+    chunks = [ds.slice(i, i + 1000) for i in range(0, 10000, 1000)]
+    s = samplers.StreamingUnderSampler(jax.random.PRNGKey(3), bootstrap_rows=2000)
+    outs = list(s.process(iter(chunks)))
+    total = np.concatenate([o.labels for o in outs])
+    after = np.bincount(total, minlength=2)
+    assert after.max() / max(after.min(), 1) < 1.25
