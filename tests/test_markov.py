@@ -1,0 +1,156 @@
+"""Markov chain, HMM builder (both tagging modes), Viterbi vs brute force."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from avenir_tpu.models import markov as mk
+
+
+def test_sequence_encoder():
+    enc = mk.SequenceEncoder().fit([["a", "b"], ["b", "c", "a"]])
+    codes, lens = enc.encode([["a", "b"], ["b", "c", "a"]])
+    assert codes.shape == (2, 3)
+    assert codes[0].tolist() == [0, 1, -1]
+    assert lens.tolist() == [2, 3]
+    assert enc.decode(codes[1]) == ["b", "c", "a"]
+
+
+def test_markov_chain_counts_and_probs():
+    seqs = [list("aab"), list("aba"), list("bb")]
+    model, enc = mk.MarkovChain(laplace=0.0).fit(seqs)
+    s = {v: i for i, v in enumerate(model.states)}
+    # pairs: aa, ab | ab, ba | bb
+    assert model.counts[s["a"], s["a"]] == 1
+    assert model.counts[s["a"], s["b"]] == 2
+    assert model.counts[s["b"], s["a"]] == 1
+    assert model.counts[s["b"], s["b"]] == 1
+    model_l, _ = mk.MarkovChain(laplace=1.0).fit(seqs)
+    probs = model_l.transition_probs()
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-9)
+
+
+def test_markov_chain_recovers_generating_matrix(rng):
+    true_p = np.array([[0.8, 0.2], [0.3, 0.7]])
+    states = ["s0", "s1"]
+    seqs = []
+    for _ in range(200):
+        cur = rng.integers(0, 2)
+        seq = [states[cur]]
+        for _ in range(50):
+            cur = rng.choice(2, p=true_p[cur])
+            seq.append(states[cur])
+        seqs.append(seq)
+    model, _ = mk.MarkovChain(laplace=1.0).fit(seqs)
+    order = [model.states.index("s0"), model.states.index("s1")]
+    est = model.transition_probs()[np.ix_(order, order)]
+    np.testing.assert_allclose(est, true_p, atol=0.03)
+
+
+def test_markov_serde_roundtrip():
+    seqs = [list("abcab"), list("cab")]
+    model, _ = mk.MarkovChain(laplace=1.0, scale=1000).fit(seqs)
+    lines = model.to_lines()
+    assert lines[0] == ",".join(model.states)
+    back = mk.MarkovChainModel.from_lines(lines, scale=1000)
+    np.testing.assert_allclose(back.transition_probs(), model.transition_probs(), atol=1e-3)
+
+
+def test_hmm_tagged_builder():
+    # deterministic toy: state x emits only o1, y emits only o2
+    seqs = [[("o1", "x"), ("o1", "x"), ("o2", "y")],
+            [("o2", "y"), ("o1", "x")]]
+    model = mk.HMMBuilder(laplace=0.0).fit_tagged(seqs)
+    sx, sy = model.states.index("x"), model.states.index("y")
+    o1, o2 = model.observations.index("o1"), model.observations.index("o2")
+    assert model.emission[sx, o1] == 1.0 and model.emission[sy, o2] == 1.0
+    # transitions: x->x, x->y | y->x
+    assert model.transition[sx, sx] == 0.5 and model.transition[sx, sy] == 0.5
+    assert model.transition[sy, sx] == 1.0
+    np.testing.assert_allclose(model.initial[[sx, sy]], [0.5, 0.5])
+
+
+def test_hmm_file_layout_roundtrip():
+    seqs = [[("a", "s"), ("b", "t")], [("b", "t"), ("a", "s")]]
+    model = mk.HMMBuilder(laplace=1.0).fit_tagged(seqs)
+    lines = model.to_lines()
+    s, o = len(model.states), len(model.observations)
+    # layout: states, observations, S A-rows, S B-rows, pi
+    assert len(lines) == 2 + 2 * s + 1
+    back = mk.HMMModel.from_lines(lines)
+    np.testing.assert_allclose(back.transition, model.transition, rtol=1e-9)
+    np.testing.assert_allclose(back.emission, model.emission, rtol=1e-9)
+    np.testing.assert_allclose(back.initial, model.initial, rtol=1e-9)
+
+
+def test_hmm_partially_tagged():
+    # tokens: observations with inline state markers; S1 near o1s, S2 near o2s
+    token_seqs = [
+        ["o1", "S1", "o1", "o2", "S2", "o2"],
+        ["o1", "S1", "o1", "o2", "S2", "o2"],
+    ]
+    model = mk.HMMBuilder(laplace=0.1).fit_partially_tagged(
+        token_seqs, states=["S1", "S2"], window_function=[1.0, 0.5])
+    s1, s2 = model.states.index("S1"), model.states.index("S2")
+    o1, o2 = model.observations.index("o1"), model.observations.index("o2")
+    assert model.emission[s1, o1] > model.emission[s1, o2]
+    assert model.emission[s2, o2] > model.emission[s2, o1]
+    assert model.transition[s1, s2] > model.transition[s1, s1]
+    assert model.initial[s1] > model.initial[s2]
+
+
+def _brute_viterbi(log_a, log_b, log_pi, obs):
+    s = log_a.shape[0]
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(s), repeat=len(obs)):
+        lp = log_pi[path[0]] + log_b[path[0], obs[0]]
+        for t in range(1, len(obs)):
+            lp += log_a[path[t - 1], path[t]] + log_b[path[t], obs[t]]
+        if lp > best:
+            best, best_path = lp, path
+    return list(best_path)
+
+
+def test_viterbi_matches_bruteforce(rng):
+    s, o, t = 3, 4, 6
+    a = rng.dirichlet(np.ones(s), size=s)
+    b = rng.dirichlet(np.ones(o), size=s)
+    pi = rng.dirichlet(np.ones(s))
+    model = mk.HMMModel([f"s{i}" for i in range(s)], [f"o{i}" for i in range(o)], a, b, pi)
+    dec = mk.ViterbiDecoder(model)
+    la, lb, lpi = np.log(a), np.log(b), np.log(pi)
+    for _ in range(8):
+        obs = rng.integers(0, o, size=t)
+        got = dec.decode_codes(obs[None, :])[0].tolist()
+        expect = _brute_viterbi(la, lb, lpi, obs)
+        assert got == expect, (got, expect)
+
+
+def test_viterbi_ragged_batch(rng):
+    s, o = 2, 3
+    a = rng.dirichlet(np.ones(s), size=s)
+    b = rng.dirichlet(np.ones(o), size=s)
+    pi = rng.dirichlet(np.ones(s))
+    model = mk.HMMModel(["x", "y"], ["p", "q", "r"], a, b, pi)
+    dec = mk.ViterbiDecoder(model)
+    seqs = [["p", "q", "r", "p"], ["q"], ["r", "p"]]
+    paths = dec.decode(seqs)
+    assert [len(p) for p in paths] == [4, 1, 2]
+    # each ragged row must equal its solo decode
+    for seq, path in zip(seqs, paths):
+        solo = dec.decode([seq])[0]
+        assert path == solo
+
+
+def test_viterbi_state_predictor_lines():
+    a = np.array([[0.8, 0.2], [0.2, 0.8]])
+    b = np.array([[0.9, 0.1], [0.1, 0.9]])
+    pi = np.array([0.5, 0.5])
+    model = mk.HMMModel(["H", "L"], ["u", "d"], a, b, pi)
+    pred = mk.ViterbiStatePredictor(model)
+    lines = pred.predict_lines([["id1", "u", "u", "d"], ["id2", "d"]])
+    assert lines[0] == "id1,H,H,L"
+    assert lines[1] == "id2,L"
+    pred2 = mk.ViterbiStatePredictor(model, pair_output=True)
+    assert pred2.predict_lines([["id3", "u", "d"]])[0] == "id3,u:H,d:L"
