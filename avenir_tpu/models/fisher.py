@@ -1,0 +1,94 @@
+"""Univariate Fisher discriminant (binary LDA per attribute).
+
+Capability parity with ``discriminant/FisherDiscriminant.java``: per-(attr,
+class) count/mean/variance accumulation (the reference reuses chombo
+``NumericalAttrStats`` mappers :56-58), then per attribute the pooled
+variance, the log-odds of the class priors, and the decision boundary
+``(μ₀+μ₁)/2 − logOdds·σ²_pooled/(μ₀−μ₁)`` (:83-96, reducer collect :98-117).
+
+TPU design: all attributes' class-conditional moments come from one
+:func:`avenir_tpu.ops.agg.class_moments` einsum; boundaries are a vectorized
+closed form. Classification (not present in the reference job, which only
+emits boundaries) follows naturally: predict class 1 when the value is on
+class 1's side of the boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from avenir_tpu.core.encoding import EncodedDataset
+from avenir_tpu.ops import agg
+
+
+@dataclass
+class FisherDiscriminantModel:
+    class_values: List[str]              # exactly two
+    mean: np.ndarray                     # [2, F]
+    var: np.ndarray                      # [2, F] unbiased per-class variance
+    count: np.ndarray                    # [2]
+    pooled_var: np.ndarray               # [F]
+    log_odds: float                      # log(P(c1)/P(c0))
+    boundary: np.ndarray                 # [F]
+
+    def to_lines(self, feature_names: Optional[List[str]] = None, delim: str = ",") -> List[str]:
+        names = feature_names or [f"f{i}" for i in range(self.mean.shape[1])]
+        return [
+            delim.join([
+                names[f],
+                repr(float(self.pooled_var[f])),
+                repr(float(self.log_odds)),
+                repr(float(self.boundary[f])),
+            ])
+            for f in range(self.mean.shape[1])
+        ]
+
+
+class FisherDiscriminant:
+    def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]]) -> FisherDiscriminantModel:
+        chunks = [data] if isinstance(data, EncodedDataset) else data
+        acc = agg.Accumulator()
+        meta = None
+        for ds in chunks:
+            meta = ds
+            if ds.labels is None:
+                raise ValueError("fit requires labels")
+            cnt, s1, s2 = agg.class_moments(jnp.asarray(ds.cont), jnp.asarray(ds.labels),
+                                            ds.num_classes)
+            acc.add("cnt", cnt)
+            acc.add("s1", s1)
+            acc.add("s2", s2)
+        if meta is None:
+            raise ValueError("no data")
+        if meta.num_classes != 2:
+            raise ValueError("Fisher discriminant requires exactly two classes")
+        if meta.num_cont == 0:
+            raise ValueError("Fisher discriminant requires continuous features")
+        cnt = acc.get("cnt")                              # [2]
+        s1, s2 = acc.get("s1"), acc.get("s2")             # [2, F]
+        n = np.maximum(cnt, 1.0)[:, None]
+        mean = s1 / n
+        var_b = np.maximum(s2 / n - mean ** 2, 1e-12)
+        var = var_b * (n / np.maximum(n - 1.0, 1.0))      # unbiased, as (n−1) division
+        pooled = (((n - 1.0) * var).sum(axis=0) / np.maximum(cnt.sum() - 2.0, 1.0))
+        log_odds = float(np.log(max(cnt[1], 1e-9) / max(cnt[0], 1e-9)))
+        delta = mean[0] - mean[1]
+        safe_delta = np.where(np.abs(delta) > 1e-9, delta, 1e-9)
+        boundary = (mean[0] + mean[1]) / 2.0 - log_odds * pooled / safe_delta
+        return FisherDiscriminantModel(
+            class_values=list(meta.class_values), mean=mean, var=var, count=cnt,
+            pooled_var=pooled, log_odds=log_odds, boundary=boundary,
+        )
+
+    @staticmethod
+    def predict(model: FisherDiscriminantModel, values: np.ndarray, attr: int = 0) -> np.ndarray:
+        """[N] class index using a single attribute's boundary: side of the
+        boundary closer to class 1's mean wins."""
+        b = model.boundary[attr]
+        class1_above = model.mean[1, attr] > model.mean[0, attr]
+        above = values[:, attr] > b
+        return np.where(above == class1_above, 1, 0).astype(np.int32)

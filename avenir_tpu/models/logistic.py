@@ -1,0 +1,150 @@
+"""Binary logistic regression — compiled full-batch gradient loop.
+
+Capability parity with the reference's iterative MR trainer
+(regress/LogisticRegressionJob.java): per-mapper gradient accumulation
+Σ x·(y−σ(wᵀx)) (:178-195 via regress/LogisticRegressor.java:61-73), single
+reducer summing partials (:261-273), coefficient history appended per
+iteration to a file that doubles as checkpoint/resume (:238-255), driver loop
+re-submitting until converged (:279-289), convergence = iteration limit or
+all/average relative coefficient delta below a percent threshold (:95-119
+via LogisticRegressor.java:105-163).
+
+Deliberate fixes (SURVEY.md §6 notes the reference emits raw aggregates as
+the next coefficients with no learning-rate application): a real
+gradient-ascent update with learning rate and optional L2, on float
+probabilities. The convergence criteria and the append-only coefficient
+history contract are preserved.
+
+TPU design: one jitted step computes the full-batch gradient as a matvec
+(batch-sharded under a mesh, XLA all-reduces the partials — exactly the
+mapper/reducer split); the Python driver loop owns the history/convergence,
+mirroring the reference's multi-job driver but in-process.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field as dc_field
+from typing import Iterable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from avenir_tpu.core.encoding import EncodedDataset
+
+
+def design_matrix(ds: EncodedDataset, include_binned: bool = True,
+                  intercept: bool = True) -> np.ndarray:
+    """[N, D] float design matrix: continuous features, one-hot binned
+    features (the TPU-friendly encoding of categoricals), optional leading
+    intercept column."""
+    parts = []
+    if intercept:
+        parts.append(np.ones((ds.num_rows, 1), np.float32))
+    if ds.num_cont:
+        parts.append(ds.cont)
+    if include_binned and ds.num_binned:
+        onehot = np.eye(ds.max_bins, dtype=np.float32)[ds.codes]     # [N, F, B]
+        mask = ds.bin_mask()                                          # [F, B]
+        parts.append(onehot[:, mask])
+    return np.concatenate(parts, axis=1) if parts else np.zeros((ds.num_rows, 0), np.float32)
+
+
+@jax.jit
+def _grad_step(w: jax.Array, x: jax.Array, y: jax.Array,
+               lr: jax.Array, l2: jax.Array) -> jax.Array:
+    """One full-batch gradient-ascent step on the log-likelihood."""
+    p = jax.nn.sigmoid(x @ w)
+    grad = x.T @ (y - p) / x.shape[0] - l2 * w
+    return w + lr * grad
+
+
+def _converged(prev: np.ndarray, cur: np.ndarray, criterion: str,
+               threshold_pct: float) -> bool:
+    """Relative per-coefficient change in percent (LogisticRegressor.java:105-163):
+    'all' = every coefficient under threshold, 'average' = mean under it."""
+    denom = np.maximum(np.abs(prev), 1e-9)
+    diff_pct = 100.0 * np.abs(cur - prev) / denom
+    if criterion == "all":
+        return bool((diff_pct < threshold_pct).all())
+    if criterion == "average":
+        return bool(diff_pct.mean() < threshold_pct)
+    raise ValueError(f"unknown convergence criterion {criterion!r}")
+
+
+@dataclass
+class LogisticRegressionModel:
+    weights: np.ndarray                      # [D]
+    history: List[np.ndarray] = dc_field(default_factory=list)   # per-iteration coeffs
+    converged: bool = False
+    iterations: int = 0
+
+    # -- coefficient-history serde (the reference's coeff file contract) -----
+    def history_lines(self, delim: str = ",") -> List[str]:
+        return [delim.join(repr(float(v)) for v in row) for row in self.history]
+
+    @classmethod
+    def from_history_lines(cls, lines: Iterable[str], delim: str = ",") -> "LogisticRegressionModel":
+        hist = [np.array([float(v) for v in line.split(delim)]) for line in lines if line.strip()]
+        if not hist:
+            raise ValueError("empty coefficient history")
+        return cls(weights=hist[-1], history=hist, converged=False, iterations=len(hist))
+
+
+class LogisticRegression:
+    def __init__(
+        self,
+        learning_rate: float = 0.5,
+        max_iterations: int = 200,
+        convergence: str = "average",        # 'all' | 'average'
+        threshold_pct: float = 0.5,
+        l2: float = 0.0,
+    ):
+        if convergence not in ("all", "average"):
+            raise ValueError("convergence must be 'all' or 'average'")
+        self.learning_rate = learning_rate
+        self.max_iterations = max_iterations
+        self.convergence = convergence
+        self.threshold_pct = threshold_pct
+        self.l2 = l2
+
+    def fit(self, x: np.ndarray, y: np.ndarray,
+            resume_from: Optional[LogisticRegressionModel] = None) -> LogisticRegressionModel:
+        """y must be 0/1. ``resume_from`` continues a previous run from its
+        last coefficient row (the reference restarts its driver loop reading
+        the last line of the coefficient file)."""
+        xd = jnp.asarray(x, jnp.float32)
+        yd = jnp.asarray(y, jnp.float32)
+        lr = jnp.float32(self.learning_rate)
+        l2 = jnp.float32(self.l2)
+        if resume_from is not None:
+            w = jnp.asarray(resume_from.weights, jnp.float32)
+            history = list(resume_from.history)
+        else:
+            w = jnp.zeros(x.shape[1], jnp.float32)
+            history = []
+        converged = False
+        it = 0
+        for it in range(1, self.max_iterations + 1):
+            w_new = _grad_step(w, xd, yd, lr, l2)
+            cur = np.asarray(w_new)
+            history.append(cur)
+            if len(history) >= 2 and _converged(history[-2], cur,
+                                                self.convergence, self.threshold_pct):
+                converged = True
+                w = w_new
+                break
+            w = w_new
+        return LogisticRegressionModel(weights=np.asarray(w), history=history,
+                                       converged=converged, iterations=len(history))
+
+    @staticmethod
+    def predict_proba(model: LogisticRegressionModel, x: np.ndarray) -> np.ndarray:
+        z = x @ model.weights
+        return 1.0 / (1.0 + np.exp(-z))
+
+    @staticmethod
+    def predict(model: LogisticRegressionModel, x: np.ndarray,
+                threshold: float = 0.5) -> np.ndarray:
+        return (LogisticRegression.predict_proba(model, x) >= threshold).astype(np.int32)

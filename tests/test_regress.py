@@ -1,0 +1,129 @@
+"""Logistic regression (vs sklearn, convergence criteria, resume) and
+Fisher discriminant (closed-form boundary oracle)."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.encoding import EncodedDataset
+from avenir_tpu.models.fisher import FisherDiscriminant
+from avenir_tpu.models.logistic import (
+    LogisticRegression, LogisticRegressionModel, design_matrix,
+)
+
+
+def _synth_logit(rng, n=4000, d=4):
+    w_true = np.array([0.5, 2.0, -1.5, 0.8, 0.0][:d + 1])
+    x = np.concatenate([np.ones((n, 1)), rng.normal(size=(n, d))], axis=1).astype(np.float32)
+    p = 1 / (1 + np.exp(-(x @ w_true)))
+    y = (rng.uniform(size=n) < p).astype(np.int32)
+    return x, y, w_true
+
+
+def test_lr_recovers_weights(rng):
+    x, y, w_true = _synth_logit(rng)
+    lr = LogisticRegression(learning_rate=2.0, max_iterations=2000, threshold_pct=0.01)
+    model = lr.fit(x, y)
+    assert model.converged
+    np.testing.assert_allclose(model.weights, w_true, atol=0.25)
+
+
+def test_lr_vs_sklearn(rng):
+    sklearn_linear = pytest.importorskip("sklearn.linear_model")
+    x, y, _ = _synth_logit(rng)
+    model = LogisticRegression(learning_rate=2.0, max_iterations=3000,
+                               threshold_pct=0.005).fit(x, y)
+    sk = sklearn_linear.LogisticRegression(penalty=None, fit_intercept=False, max_iter=2000)
+    sk.fit(x, y)
+    np.testing.assert_allclose(model.weights, sk.coef_[0], atol=0.08)
+    ours = (LogisticRegression.predict(model, x) == y).mean()
+    theirs = sk.score(x, y)
+    assert abs(ours - theirs) < 0.01
+
+
+def test_lr_convergence_criteria(rng):
+    x, y, _ = _synth_logit(rng, n=1000)
+    avg = LogisticRegression(convergence="average", threshold_pct=1.0,
+                             max_iterations=500).fit(x, y)
+    al = LogisticRegression(convergence="all", threshold_pct=1.0,
+                            max_iterations=500).fit(x, y)
+    # 'all' is stricter: must take at least as many iterations
+    assert al.iterations >= avg.iterations
+    with pytest.raises(ValueError):
+        LogisticRegression(convergence="bogus")
+
+
+def test_lr_history_and_resume(rng):
+    x, y, _ = _synth_logit(rng, n=1500)
+    first = LogisticRegression(max_iterations=10, threshold_pct=0.0).fit(x, y)
+    assert first.iterations == 10 and len(first.history) == 10
+    # serde round trip
+    back = LogisticRegressionModel.from_history_lines(first.history_lines())
+    np.testing.assert_allclose(back.weights, first.weights, rtol=1e-6)
+    # resume == uninterrupted run
+    resumed = LogisticRegression(max_iterations=10, threshold_pct=0.0).fit(
+        x, y, resume_from=back)
+    straight = LogisticRegression(max_iterations=20, threshold_pct=0.0).fit(x, y)
+    np.testing.assert_allclose(resumed.weights, straight.weights, atol=1e-5)
+    assert len(resumed.history) == 20
+    with pytest.raises(ValueError):
+        LogisticRegressionModel.from_history_lines([])
+
+
+def test_design_matrix():
+    ds = EncodedDataset(
+        codes=np.array([[0], [2]], np.int32),
+        cont=np.array([[1.5], [2.5]], np.float32),
+        labels=np.array([0, 1], np.int32),
+        n_bins=np.array([3], np.int32),
+        class_values=["a", "b"],
+    )
+    x = design_matrix(ds)
+    # intercept + 1 cont + 3 one-hot bins
+    assert x.shape == (2, 5)
+    np.testing.assert_allclose(x[0], [1, 1.5, 1, 0, 0])
+    np.testing.assert_allclose(x[1], [1, 2.5, 0, 0, 1])
+    x2 = design_matrix(ds, include_binned=False, intercept=False)
+    assert x2.shape == (2, 1)
+
+
+def test_fisher_boundary_oracle(rng):
+    n = 6000
+    labels = (rng.uniform(size=n) < 0.3).astype(np.int32)
+    x = np.where(labels[:, None] == 1,
+                 rng.normal(3.0, 1.0, size=(n, 2)),
+                 rng.normal(0.0, 1.0, size=(n, 2))).astype(np.float32)
+    ds = EncodedDataset(
+        codes=np.zeros((n, 0), np.int32), cont=x, labels=labels,
+        n_bins=np.zeros(0, np.int32), class_values=["neg", "pos"])
+    model = FisherDiscriminant().fit(ds)
+    # manual oracle for attribute 0
+    m0, m1 = x[labels == 0, 0].mean(), x[labels == 1, 0].mean()
+    v0 = x[labels == 0, 0].var(ddof=1)
+    v1 = x[labels == 1, 0].var(ddof=1)
+    n0, n1 = (labels == 0).sum(), (labels == 1).sum()
+    pooled = ((n0 - 1) * v0 + (n1 - 1) * v1) / (n0 + n1 - 2)
+    log_odds = np.log(n1 / n0)
+    expect = (m0 + m1) / 2 - log_odds * pooled / (m0 - m1)
+    np.testing.assert_allclose(model.boundary[0], expect, rtol=1e-4)
+    np.testing.assert_allclose(model.pooled_var[0], pooled, rtol=1e-4)
+    # classification accuracy is high on well-separated classes
+    pred = FisherDiscriminant.predict(model, x, attr=0)
+    assert (pred == labels).mean() > 0.9
+    lines = model.to_lines(["a", "b"])
+    assert lines[0].startswith("a,") and len(lines) == 2
+
+
+def test_fisher_requires_binary_and_continuous(rng):
+    ds3 = EncodedDataset(
+        codes=np.zeros((10, 0), np.int32),
+        cont=rng.normal(size=(10, 1)).astype(np.float32),
+        labels=np.array([0, 1, 2] * 3 + [0], np.int32),
+        n_bins=np.zeros(0, np.int32), class_values=["a", "b", "c"])
+    with pytest.raises(ValueError):
+        FisherDiscriminant().fit(ds3)
+    ds_nc = EncodedDataset(
+        codes=np.zeros((4, 1), np.int32), cont=np.zeros((4, 0), np.float32),
+        labels=np.array([0, 1, 0, 1], np.int32),
+        n_bins=np.array([2], np.int32), class_values=["a", "b"])
+    with pytest.raises(ValueError):
+        FisherDiscriminant().fit(ds_nc)
