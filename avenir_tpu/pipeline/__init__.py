@@ -1,0 +1,15 @@
+from avenir_tpu.pipeline.streaming import (
+    InProcQueue,
+    QueueActionWriter,
+    QueueRewardReader,
+    QueueEventSource,
+    ReinforcementLearnerServer,
+)
+
+__all__ = [
+    "InProcQueue",
+    "QueueActionWriter",
+    "QueueRewardReader",
+    "QueueEventSource",
+    "ReinforcementLearnerServer",
+]

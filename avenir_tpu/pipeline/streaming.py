@@ -1,0 +1,212 @@
+"""Streaming serving loop — the Storm/Redis topology replacement.
+
+Capability parity with the reference's real-time path
+(``reinforce/ReinforcementLearnerTopology.java`` builds RedisSpout →
+shuffle → learner bolt :42-85; ``RedisSpout.java`` rpop's
+``(eventID, roundNum)`` events :86-100; ``ReinforcementLearnerBolt.java``
+drains the reward queue into ``learner.setReward`` then calls
+``learner.nextActions(round)`` and writes to the action queue :93-125;
+pluggable queue I/O via ``ActionWriter`` / ``RewardReader`` interfaces with
+Redis impls — lpush actions ``RedisActionWriter.java:46-49``, lindex walk of
+the reward list ``RedisRewardReader.java:72-86``).
+
+Re-design: the topology collapses into an in-process event loop around the
+learner — the queue abstraction survives (in-proc deques for tests and
+embedding; Redis transports gated on the ``redis`` package for drop-in use
+against the reference's own simulators). Learner state is checkpointable
+between events (the reference loses bolt state on restart, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Callable, Iterable, List, Optional, Protocol, Tuple
+
+from avenir_tpu.models.online_rl import ReinforcementLearner
+
+
+# ---------------------------------------------------------------------------
+# queue transports
+# ---------------------------------------------------------------------------
+
+class InProcQueue:
+    """Deque-backed FIFO with the push/pop surface the Redis impls use."""
+
+    def __init__(self):
+        self._q = deque()
+
+    def push(self, msg: str) -> None:
+        self._q.appendleft(msg)
+
+    def pop(self) -> Optional[str]:
+        return self._q.pop() if self._q else None
+
+    def drain(self) -> List[str]:
+        out = list(reversed(self._q))
+        self._q.clear()
+        return out
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+class EventSource(Protocol):
+    def next_event(self) -> Optional[Tuple[str, int]]: ...
+
+
+class RewardReader(Protocol):
+    def read_rewards(self) -> List[Tuple[str, float]]: ...
+
+
+class ActionWriter(Protocol):
+    def write(self, event_id: str, actions: List[str]) -> None: ...
+
+
+class QueueEventSource:
+    """Events are ``eventID,roundNum`` lines (RedisSpout.java:86-100)."""
+
+    def __init__(self, queue: InProcQueue, delim: str = ","):
+        self.queue = queue
+        self.delim = delim
+
+    def next_event(self) -> Optional[Tuple[str, int]]:
+        msg = self.queue.pop()
+        if msg is None:
+            return None
+        event_id, _, round_num = msg.partition(self.delim)
+        return event_id, int(round_num)
+
+
+class QueueRewardReader:
+    """Rewards are ``action,reward`` lines."""
+
+    def __init__(self, queue: InProcQueue, delim: str = ","):
+        self.queue = queue
+        self.delim = delim
+
+    def read_rewards(self) -> List[Tuple[str, float]]:
+        out = []
+        for msg in self.queue.drain():
+            action, _, reward = msg.partition(self.delim)
+            out.append((action, float(reward)))
+        return out
+
+
+class QueueActionWriter:
+    """Actions are written as ``eventID,action`` (RedisActionWriter.java:46-49)."""
+
+    def __init__(self, queue: InProcQueue, delim: str = ","):
+        self.queue = queue
+        self.delim = delim
+
+    def write(self, event_id: str, actions: List[str]) -> None:
+        for a in actions:
+            self.queue.push(f"{event_id}{self.delim}{a}")
+
+
+# Redis transports — drop-in against the reference's own simulators; gated on
+# the redis package being present (it is not baked into this image).
+try:  # pragma: no cover - environment dependent
+    import redis as _redis
+
+    class RedisEventSource:
+        def __init__(self, host="localhost", port=6379, db=0, queue="eventQueue", delim=","):
+            self._r = _redis.StrictRedis(host=host, port=port, db=db)
+            self.queue = queue
+            self.delim = delim
+
+        def next_event(self):
+            msg = self._r.rpop(self.queue)
+            if msg is None:
+                return None
+            text = msg.decode() if isinstance(msg, bytes) else msg
+            event_id, _, round_num = text.partition(self.delim)
+            return event_id, int(round_num)
+
+    class RedisRewardReader:
+        def __init__(self, host="localhost", port=6379, db=0, queue="rewardQueue", delim=","):
+            self._r = _redis.StrictRedis(host=host, port=port, db=db)
+            self.queue = queue
+            self.delim = delim
+
+        def read_rewards(self):
+            out = []
+            while True:
+                msg = self._r.rpop(self.queue)
+                if msg is None:
+                    break
+                text = msg.decode() if isinstance(msg, bytes) else msg
+                action, _, reward = text.partition(self.delim)
+                out.append((action, float(reward)))
+            return out
+
+    class RedisActionWriter:
+        def __init__(self, host="localhost", port=6379, db=0, queue="actionQueue", delim=","):
+            self._r = _redis.StrictRedis(host=host, port=port, db=db)
+            self.queue = queue
+            self.delim = delim
+
+        def write(self, event_id, actions):
+            for a in actions:
+                self._r.lpush(self.queue, f"{event_id}{self.delim}{a}")
+
+    HAVE_REDIS = True
+except ImportError:  # pragma: no cover
+    HAVE_REDIS = False
+
+
+# ---------------------------------------------------------------------------
+# the serving loop (the bolt, minus Storm)
+# ---------------------------------------------------------------------------
+
+class ReinforcementLearnerServer:
+    """Per event: drain rewards → update learner → emit next actions
+    (ReinforcementLearnerBolt.java:93-125)."""
+
+    def __init__(
+        self,
+        learner: ReinforcementLearner,
+        events: EventSource,
+        rewards: RewardReader,
+        actions: ActionWriter,
+        log_interval: int = 0,
+        on_log: Optional[Callable[[int], None]] = None,
+    ):
+        self.learner = learner
+        self.events = events
+        self.rewards = rewards
+        self.actions = actions
+        self.log_interval = log_interval
+        self.on_log = on_log
+        self.processed = 0
+
+    def process_one(self) -> bool:
+        """Handle one event; False when the event queue is empty."""
+        ev = self.events.next_event()
+        if ev is None:
+            return False
+        event_id, round_num = ev
+        for action, reward in self.rewards.read_rewards():
+            self.learner.set_reward(action, reward)
+        selected = self.learner.next_actions(round_num)
+        self.actions.write(event_id, selected)
+        self.processed += 1
+        if self.log_interval and self.on_log and self.processed % self.log_interval == 0:
+            self.on_log(self.processed)
+        return True
+
+    def run(self, max_events: Optional[int] = None) -> int:
+        n = 0
+        while max_events is None or n < max_events:
+            if not self.process_one():
+                break
+            n += 1
+        return n
+
+    # -- learner-state checkpointing ----------------------------------------
+    def checkpoint(self) -> str:
+        return json.dumps(self.learner.get_state())
+
+    def restore(self, blob: str) -> None:
+        self.learner.set_state(json.loads(blob))

@@ -1,0 +1,213 @@
+"""RL suite: batch bandit convergence on planted reward structure (incl. the
+price-optimization scenario), online learners, closed-loop serving
+(lead_gen), pool utilities, checkpoint/restore."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from avenir_tpu.models import bandits as bd
+from avenir_tpu.models import online_rl as orl
+from avenir_tpu.pipeline import streaming as st
+
+
+# ---------------------------------------------------------------------------
+# batch bandits
+# ---------------------------------------------------------------------------
+
+def _simulate_rounds(job: bd.BanditJob, true_means: np.ndarray, rounds: int, rng):
+    """Round loop: select per group, draw noisy reward from planted means,
+    update running (count, mean-reward) — the external loop the tutorial
+    scripts drive (price_optimize_tutorial.txt:42-78)."""
+    g, k = true_means.shape
+    rows = [[f"g{gi}", f"i{ai}", "0", "0"] for gi in range(g) for ai in range(k)]
+    state = bd.GroupState.from_rows(rows)
+    picks = np.zeros((g, k), np.int64)
+    for r in range(1, rounds + 1):
+        sel = job.select(state, r)
+        for grp, item in sel:
+            gi = int(grp[1:]); ai = int(item[1:])
+            reward = max(rng.normal(true_means[gi, ai], 5.0), 0.0)
+            state.update(grp, item, reward)
+            picks[gi, ai] += 1
+    return state, picks
+
+
+@pytest.mark.parametrize("algorithm,kwargs", [
+    ("greedyRandomLinear", {"prob_reduction_constant": 20.0}),
+    ("greedyRandomLogLinear", {"prob_reduction_constant": 10.0}),
+    ("auerGreedy", {"auer_constant": 5.0}),
+    ("auerDeterministic", {}),
+    ("softMax", {"tau": 0.05}),
+    ("randomFirstGreedy", {"exploration_count_factor": 10}),
+])
+def test_bandits_find_best_arm(algorithm, kwargs, rng):
+    true_means = np.array([[20.0, 50.0, 35.0], [80.0, 30.0, 55.0]])
+    job = bd.BanditJob(algorithm, seed=1, **kwargs)
+    _, picks = _simulate_rounds(job, true_means, rounds=300, rng=rng)
+    # best arm must dominate selections in the exploitation phase
+    for gi in range(2):
+        best = np.argmax(true_means[gi])
+        assert picks[gi, best] == picks[gi].max(), (algorithm, picks)
+        assert picks[gi, best] > 100, (algorithm, picks[gi])
+
+
+def test_bandit_price_optimization(rng):
+    """price_opt.py scenario: concave revenue curves per product — the bandit
+    must converge to the revenue-maximizing price arm."""
+    g, k = 20, 8
+    peak = rng.integers(1, k - 1, size=g)
+    prices = np.arange(k)
+    true_rev = 20000 - 800.0 * (prices[None, :] - peak[:, None]) ** 2
+    job = bd.BanditJob("auerDeterministic", seed=2)
+    state, picks = _simulate_rounds(job, true_rev / 200.0, rounds=400, rng=rng)
+    correct = sum(int(np.argmax(picks[gi]) == peak[gi]) for gi in range(g))
+    assert correct >= g * 0.8, f"only {correct}/{g} products found their peak price"
+    # row round trip
+    rows = state.to_rows()
+    state2 = bd.GroupState.from_rows(rows)
+    np.testing.assert_allclose(state2.counts, state.counts)
+
+
+def test_bandit_select_lines_contract():
+    rows = [["g1", "a", "3", "10.0"], ["g1", "b", "2", "20.0"], ["g2", "x", "1", "5.0"]]
+    job = bd.BanditJob("auerDeterministic")
+    lines = job.select_lines(rows, round_num=50)
+    assert len(lines) == 2
+    assert lines[0].startswith("g1,") and lines[1].startswith("g2,")
+    with pytest.raises(ValueError):
+        bd.BanditJob("bogus")
+
+
+def test_ucb1_prefers_untried_then_value():
+    counts = np.array([[5.0, 0.0, 5.0]])
+    rewards = np.array([[10.0, 0.0, 5.0]])
+    valid = np.ones((1, 3), bool)
+    sel = bd.AuerDeterministicBandit().select(jax.random.PRNGKey(0), counts, rewards, valid, 1)
+    assert sel[0] == 1          # untried first
+    counts2 = np.array([[50.0, 50.0, 50.0]])
+    sel2 = bd.AuerDeterministicBandit().select(jax.random.PRNGKey(0), counts2, rewards, valid, 1)
+    assert sel2[0] == 0         # then max value
+
+
+def test_explore_first_window():
+    b = bd.RandomFirstGreedyBandit(strategy="simple", exploration_count_factor=2)
+    counts = np.zeros((1, 4)); rewards = np.zeros((1, 4))
+    rewards[0, 2] = 10; counts[0, 2] = 1
+    valid = np.ones((1, 4), bool)
+    seen = set()
+    for r in range(1, 9):     # exploration budget = 8 rounds
+        sel = b.select(jax.random.PRNGKey(r), counts, rewards, valid, r)
+        seen.add(int(sel[0]))
+    assert seen == {0, 1, 2, 3}          # swept all arms
+    sel = b.select(jax.random.PRNGKey(99), counts, rewards, valid, 100)
+    assert sel[0] == 2                   # greedy afterwards
+    # PAC budget formula
+    pac = bd.RandomFirstGreedyBandit(strategy="pac", reward_diff=0.5, prob_diff=0.1)
+    assert pac.exploration_count(4) == int(4 / 0.25 + np.log(2 * 4 / 0.1))
+
+
+# ---------------------------------------------------------------------------
+# online learners
+# ---------------------------------------------------------------------------
+
+def _feed_and_count(learner, true_means, rounds, rng, warm=None):
+    picks = {a: 0 for a in true_means}
+    for r in range(1, rounds + 1):
+        action = learner.next_actions(r)[0]
+        reward = max(rng.normal(*true_means[action]), 0.0)
+        learner.set_reward(action, reward)
+        if r > (warm or rounds // 2):
+            picks[action] += 1
+    return picks
+
+
+@pytest.mark.parametrize("name", sorted(orl.LEARNER_REGISTRY))
+def test_online_learners_converge(name, rng):
+    true_means = {"a": (20, 5), "b": (50, 5), "c": (35, 5)}
+    cfg = {"min.reward.distr.sample": 20, "min.sample": 20, "max.reward": 60.0,
+           "prob.reduction.constant": 30.0,
+           "confidence.limit.reduction.round.interval": 20}
+    learner = orl.create_learner(name, ["a", "b", "c"], cfg, seed=7)
+    picks = _feed_and_count(learner, true_means, rounds=600, rng=rng)
+    assert max(picks, key=picks.get) == "b", (name, picks)
+
+
+def test_learner_factory_and_state():
+    learner = orl.create_learner("sampsonSampler", ["x", "y"], {"min.sample": 2}, seed=1)
+    learner.set_reward("x", 5.0)
+    learner.set_reward("y", 9.0)
+    blob = learner.get_state()
+    fresh = orl.create_learner("sampsonSampler", ["x", "y"], {"min.sample": 2}, seed=1)
+    fresh.set_state(blob)
+    assert fresh.stats["y"].rewards == [9.0]
+    with pytest.raises(ValueError):
+        orl.create_learner("bogus", ["x"])
+
+
+def test_optimistic_sampler_floors_at_mean():
+    learner = orl.create_learner("optimisticSampsonSampler", ["x"],
+                                 {"min.sample": 1, "max.reward": 10}, seed=3)
+    for v in (1.0, 9.0):
+        learner.set_reward("x", v)
+    # mean is 5; sampled value is one of {1, 9} floored at 5 -> always >= 5
+    for _ in range(20):
+        assert learner.sample_reward("x") >= 5.0
+
+
+def test_grouped_items_and_exploration_counter():
+    gi = orl.GroupedItems([orl.Item("a", 0, 0), orl.Item("b", 3, 7.0), orl.Item("c", 0, 0)])
+    assert [i.item_id for i in gi.collect_items_not_tried(5)] == ["a", "c"]
+    assert gi.get_max_reward_item().item_id == "b"
+    assert gi.size() == 3
+    ec = orl.ExplorationCounter(count=3, batch_size=2, exploration_count=6)
+    ec.select_next_round(1)
+    assert ec.in_exploration()
+    idx = ec.selected_indices()
+    assert len(idx) == 2 and all(0 <= i < 3 for i in idx)
+    ec.select_next_round(10)
+    assert not ec.in_exploration()
+
+
+# ---------------------------------------------------------------------------
+# closed-loop serving (the lead_gen.py scenario, in-proc)
+# ---------------------------------------------------------------------------
+
+def test_serving_loop_converges_to_best_page(rng):
+    """Port of resource/lead_gen.py: pages with CTR gaussians
+    (page1 30±12, page2 60±30, page3 80±10) — the served learner must
+    converge to page3."""
+    ctr = {"page1": (30, 12), "page2": (60, 30), "page3": (80, 10)}
+    events, rewards, actions = st.InProcQueue(), st.InProcQueue(), st.InProcQueue()
+    learner = orl.create_learner(
+        "intervalEstimator", list(ctr), {"min.reward.distr.sample": 15,
+                                         "confidence.limit.reduction.round.interval": 25},
+        seed=11)
+    server = st.ReinforcementLearnerServer(
+        learner, st.QueueEventSource(events), st.QueueRewardReader(rewards),
+        st.QueueActionWriter(actions))
+    picks = {p: 0 for p in ctr}
+    total = 800
+    for round_num in range(1, total + 1):
+        events.push(f"ev{round_num},{round_num}")
+        assert server.process_one()
+        msg = actions.pop()
+        _, page = msg.split(",")
+        mu, sd = ctr[page]
+        rewards.push(f"{page},{max(rng.normal(mu, sd), 0.0)}")
+        if round_num > total // 2:
+            picks[page] += 1
+    assert max(picks, key=picks.get) == "page3", picks
+    assert server.processed == total
+    # queue empty -> run() returns 0
+    assert server.run(max_events=5) == 0
+    # checkpoint/restore round trip (the capability Storm lacked)
+    blob = server.checkpoint()
+    learner2 = orl.create_learner(
+        "intervalEstimator", list(ctr), {"min.reward.distr.sample": 15}, seed=11)
+    server2 = st.ReinforcementLearnerServer(
+        learner2, st.QueueEventSource(events), st.QueueRewardReader(rewards),
+        st.QueueActionWriter(actions))
+    server2.restore(blob)
+    assert learner2.stats["page3"].count == learner.stats["page3"].count
