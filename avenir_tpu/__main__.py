@@ -1,0 +1,68 @@
+"""CLI — the ``hadoop jar avenir-1.0.jar <ToolClass> -Dconf.path=<props>
+<in> <out>`` contract as ``python -m avenir_tpu <JobName> -Dconf.path=<props>
+<in> <out>``.
+
+Accepts the reference's fully-qualified class names or simple names, ``-D``
+property overrides (applied over the properties file, as Hadoop's
+GenericOptionsParser does), and prints the job counters on completion the way
+the Hadoop job client did.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Tuple
+
+
+def parse_args(argv: List[str]) -> Tuple[str, Dict[str, str], List[str]]:
+    if not argv:
+        raise SystemExit(
+            "usage: python -m avenir_tpu <JobName> [-Dkey=value ...] <input> <output>\n"
+            "       python -m avenir_tpu --list")
+    job_name = argv[0]
+    overrides: Dict[str, str] = {}
+    positional: List[str] = []
+    for arg in argv[1:]:
+        if arg.startswith("-D"):
+            body = arg[2:]
+            if "=" not in body:
+                raise SystemExit(f"bad -D option (need -Dkey=value): {arg!r}")
+            k, v = body.split("=", 1)
+            overrides[k.strip()] = v.strip()
+        else:
+            positional.append(arg)
+    return job_name, overrides, positional
+
+
+def main(argv: List[str]) -> int:
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        # the image's sitecustomize pins the jax_platforms *config* to the TPU
+        # tunnel, which beats the env var — honor an explicit CPU request
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.jobs import REGISTRY, get_job
+
+    if argv and argv[0] in ("--list", "list"):
+        for name in sorted(k for k in REGISTRY if "." not in k):
+            print(name)
+        return 0
+    job_name, overrides, positional = parse_args(argv)
+    conf_path = overrides.pop("conf.path", None)
+    conf = JobConfig.from_file(conf_path) if conf_path else JobConfig()
+    for k, v in overrides.items():
+        conf.set(k, v)
+    if len(positional) != 2:
+        raise SystemExit(f"expected <input> <output>, got {positional}")
+    job = get_job(job_name)
+    counters = job.run(conf, positional[0], positional[1])
+    for group, vals in sorted(counters.as_dict().items()):
+        print(group)
+        for k, v in sorted(vals.items()):
+            print(f"\t{k}={v}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,100 @@
+"""Job registry — reference Tool class names → TPU-native jobs.
+
+Jobs are addressable by the reference's fully-qualified class name
+(``org.avenir.bayesian.BayesianDistribution``) or the simple name, so the
+reference's runbooks translate verb-for-verb.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from avenir_tpu.jobs.base import Job
+from avenir_tpu.jobs.bayesian import BayesianDistribution, BayesianPredictor
+from avenir_tpu.jobs.explore import (
+    BaggingSampler,
+    CramerCorrelation,
+    HeterogeneityReductionCorrelation,
+    MutualInformation,
+    UnderSamplingBalancer,
+)
+from avenir_tpu.jobs.knn import (
+    FeatureCondProbJoiner,
+    NearestNeighbor,
+    SameTypeSimilarity,
+)
+from avenir_tpu.jobs.markov import (
+    HiddenMarkovModelBuilder,
+    MarkovStateTransitionModel,
+    ViterbiStatePredictor,
+)
+from avenir_tpu.jobs.regress import FisherDiscriminant, LogisticRegressionJob
+from avenir_tpu.jobs.reinforce import (
+    AuerDeterministic,
+    GreedyRandomBandit,
+    RandomFirstGreedyBandit,
+    SoftMaxBandit,
+)
+from avenir_tpu.jobs.text import WordCounter
+from avenir_tpu.jobs.tree import (
+    ClassPartitionGenerator,
+    DataPartitioner,
+    DecisionTreeBuilder,
+    SplitGenerator,
+)
+
+# reference package of each job's counterpart (for fully-qualified lookup)
+_PACKAGES: Dict[str, str] = {
+    "BayesianDistribution": "bayesian",
+    "BayesianPredictor": "bayesian",
+    "MutualInformation": "explore",
+    "CramerCorrelation": "explore",
+    "HeterogeneityReductionCorrelation": "explore",
+    "BaggingSampler": "explore",
+    "UnderSamplingBalancer": "explore",
+    "ClassPartitionGenerator": "explore",
+    "SplitGenerator": "tree",
+    "DataPartitioner": "tree",
+    "DecisionTreeBuilder": "tree",
+    "NearestNeighbor": "knn",
+    "FeatureCondProbJoiner": "knn",
+    "SameTypeSimilarity": "knn",
+    "MarkovStateTransitionModel": "markov",
+    "HiddenMarkovModelBuilder": "markov",
+    "ViterbiStatePredictor": "markov",
+    "LogisticRegressionJob": "regress",
+    "FisherDiscriminant": "discriminant",
+    "GreedyRandomBandit": "reinforce",
+    "AuerDeterministic": "reinforce",
+    "SoftMaxBandit": "reinforce",
+    "RandomFirstGreedyBandit": "reinforce",
+    "WordCounter": "text",
+}
+
+JOB_CLASSES = [
+    BayesianDistribution, BayesianPredictor,
+    MutualInformation, CramerCorrelation, HeterogeneityReductionCorrelation,
+    BaggingSampler, UnderSamplingBalancer,
+    ClassPartitionGenerator, SplitGenerator, DataPartitioner, DecisionTreeBuilder,
+    NearestNeighbor, FeatureCondProbJoiner, SameTypeSimilarity,
+    MarkovStateTransitionModel, HiddenMarkovModelBuilder, ViterbiStatePredictor,
+    LogisticRegressionJob, FisherDiscriminant,
+    GreedyRandomBandit, AuerDeterministic, SoftMaxBandit, RandomFirstGreedyBandit,
+    WordCounter,
+]
+
+REGISTRY: Dict[str, Type[Job]] = {}
+for _cls in JOB_CLASSES:
+    REGISTRY[_cls.name] = _cls
+    pkg = _PACKAGES.get(_cls.name)
+    if pkg:
+        REGISTRY[f"org.avenir.{pkg}.{_cls.name}"] = _cls
+
+
+def get_job(name: str) -> Job:
+    try:
+        return REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown job {name!r}; known: "
+            f"{sorted(k for k in REGISTRY if '.' not in k)}") from None

@@ -1,0 +1,131 @@
+"""Job layer — the reference's ``hadoop jar <ToolClass> -Dconf.path=p in out``
+contract, minus the cluster.
+
+Every reference algorithm ships as a Hadoop ``Tool`` with a ``run()`` wiring
+mappers/reducers and a CSV-in/CSV-out + properties + JSON-schema driver
+contract (e.g. bayesian/BayesianDistribution.java:58-84). Here a job is a
+plain object with ``run(conf, input_path, output_path) -> Counters``: input is
+a CSV file or a directory of part files, output is written as
+``<out>/part-00000`` (the MR output-directory convention scripts like
+resource/knn.sh already expect), and the properties file / feature schema keep
+their reference key names (``feature.schema.file.path``,
+``field.delim.regex``, ...).
+
+The execution substrate is the in-process TPU engine: instead of a mapper
+fleet + shuffle + reducer, each job streams encoded chunks through jitted
+aggregation kernels (see avenir_tpu.ops.agg) and writes its output lines from
+host memory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.csv_io import iter_csv_chunks, read_csv
+from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.utils.metrics import Counters
+
+PART_FILE = "part-00000"
+
+
+def input_files(path: str) -> List[str]:
+    """Resolve a job input path (file, or dir of part files) to file list.
+
+    Directory reads skip hidden files and ``_SUCCESS`` markers, mirroring
+    Hadoop's FileInputFormat conventions.
+    """
+    if os.path.isdir(path):
+        names = sorted(
+            n for n in os.listdir(path)
+            if not n.startswith(".") and not n.startswith("_")
+        )
+        return [os.path.join(path, n) for n in names]
+    return [path]
+
+
+def read_input(path: str, delim: str = ",") -> np.ndarray:
+    """All input rows as one [N, ncols] object array of strings."""
+    chunks = [read_csv(f, delim=delim) for f in input_files(path)]
+    chunks = [c for c in chunks if c.size]
+    if not chunks:
+        return np.empty((0, 0), dtype=object)
+    return np.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
+
+
+def iter_input_chunks(path: str, chunk_rows: int = 1_000_000,
+                      delim: str = ",") -> Iterator[np.ndarray]:
+    for f in input_files(path):
+        yield from iter_csv_chunks(f, chunk_rows=chunk_rows, delim=delim)
+
+
+def write_output(path: str, lines: Sequence[str], part: str = PART_FILE) -> str:
+    """Write job output lines under ``<path>/<part>`` (MR layout); returns the
+    part-file path. A path that already names a file (has an extension and a
+    non-dir parent semantic) is honored as a plain file for single-artifact
+    outputs like the LR coefficient file."""
+    if path.endswith(os.sep) or not os.path.splitext(path)[1]:
+        os.makedirs(path, exist_ok=True)
+        target = os.path.join(path, part)
+    else:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        target = path
+    with open(target, "w") as fh:
+        for line in lines:
+            fh.write(line)
+            fh.write("\n")
+    return target
+
+
+def read_lines(path: str) -> List[str]:
+    out: List[str] = []
+    for f in input_files(path):
+        with open(f) as fh:
+            out.extend(line.rstrip("\n") for line in fh if line.strip())
+    return out
+
+
+class Job:
+    """Base: subclasses set ``name`` (the reference Tool class simple name)
+    and implement :meth:`execute`."""
+
+    name: str = ""
+
+    def run(self, conf: JobConfig, input_path: str, output_path: str) -> Counters:
+        counters = Counters()
+        self.execute(conf, input_path, output_path, counters)
+        return counters
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        raise NotImplementedError
+
+    # -- shared plumbing -----------------------------------------------------
+    @staticmethod
+    def load_schema(conf: JobConfig) -> FeatureSchema:
+        path = conf.get("feature.schema.file.path")
+        if not path:
+            raise ValueError("feature.schema.file.path not set")
+        return FeatureSchema.from_file(path)
+
+    @staticmethod
+    def encoder_for(conf: JobConfig) -> DatasetEncoder:
+        return DatasetEncoder(Job.load_schema(conf))
+
+    @staticmethod
+    def encode_input(conf: JobConfig, input_path: str,
+                     with_labels: bool = True,
+                     encoder: Optional[DatasetEncoder] = None):
+        """(encoder, encoded dataset) for whole-input jobs."""
+        delim = conf.field_delim_regex
+        rows = read_input(input_path, delim=delim)
+        enc = encoder or Job.encoder_for(conf)
+        ds = enc.fit_transform(rows, with_labels=with_labels) if not enc._fitted \
+            else enc.transform(rows, with_labels=with_labels)
+        return enc, ds, rows

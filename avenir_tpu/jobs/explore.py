@@ -1,0 +1,151 @@
+"""Exploration jobs — mutual information, categorical correlation, and class
+samplers (explore/MutualInformation.java, CramerCorrelation.java,
+HeterogeneityReductionCorrelation.java, BaggingSampler.java,
+UnderSamplingBalancer.java) on the in-process TPU engine.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import numpy as np
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.jobs.base import Job, read_input, write_output
+from avenir_tpu.models import correlation as corr
+from avenir_tpu.models import mutual_info as mi
+from avenir_tpu.models import samplers
+from avenir_tpu.utils.metrics import Counters
+
+
+class MutualInformation(Job):
+    """One-pass distributions + MI + feature-selection scores.
+
+    Output sections mirror the reference reducer's cleanup
+    (MutualInformation.java:462-471): all distributions, mutual-information
+    values, then one ranked feature subset per algorithm in
+    ``mutual.info.score.algorithms`` (mim/mifs/jmi/disr/mrmr;
+    MutualInformationScore.java).
+    """
+
+    name = "MutualInformation"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim
+        schema = self.load_schema(conf)
+        _enc, ds, _rows = self.encode_input(conf, input_path)
+        names = [schema.field_by_ordinal(o).name for o in ds.binned_ordinals]
+        result = mi.MutualInformation().fit(ds, feature_names=names)
+        lines: List[str] = []
+        if conf.get_bool("output.mutual.info", True):
+            lines.extend(result.to_lines(delim=delim))
+        for algo in conf.get_list("mutual.info.score.algorithms", ["mim"]):
+            kwargs = {}
+            if algo == "mifs":
+                kwargs["redundancy_factor"] = conf.get_float(
+                    "mutual.info.redundancy.factor", 1.0)
+            ranked = mi.score_features(result, algo, **kwargs)
+            lines.append(f"featureScore:{algo}")
+            lines.extend(
+                delim.join([names[f], f"{score:.6f}"]) for f, score in ranked)
+        write_output(output_path, lines)
+        counters.set("Records", "Processed", ds.num_rows)
+
+
+class _CorrelationJob(Job):
+    algorithm = "cramerIndex"
+
+    def _algorithm(self, conf: JobConfig) -> str:
+        return self.algorithm
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim
+        schema = self.load_schema(conf)
+        _enc, ds, _rows = self.encode_input(conf, input_path)
+        names = [schema.field_by_ordinal(o).name for o in ds.binned_ordinals]
+        # source/dest attribute lists arrive as schema ordinals
+        # (CramerCorrelation.java:95-100); map them to binned indices
+        ord_to_idx = {o: i for i, o in enumerate(ds.binned_ordinals)}
+        src = conf.get_int_list("source.attributes")
+        dst = conf.get_int_list("dest.attributes")
+        class_ord = schema.class_field.ordinal if schema.class_field else None
+        against_class = dst is not None and class_ord is not None and dst == [class_ord]
+        job = corr.CategoricalCorrelation(algorithm=self._algorithm(conf))
+        result = job.fit(
+            ds,
+            src=[ord_to_idx[o] for o in src] if src else None,
+            dst=(None if against_class or dst is None
+                 else [ord_to_idx[o] for o in dst]),
+            against_class=against_class,
+            feature_names=names,
+        )
+        write_output(output_path, result.to_lines(delim=delim))
+        counters.set("Records", "Processed", ds.num_rows)
+
+
+class CramerCorrelation(_CorrelationJob):
+    name = "CramerCorrelation"
+    algorithm = "cramerIndex"
+
+
+class HeterogeneityReductionCorrelation(_CorrelationJob):
+    name = "HeterogeneityReductionCorrelation"
+
+    def _algorithm(self, conf: JobConfig) -> str:
+        # reference values: concentration | uncertainty
+        # (HeterogeneityReductionCorrelation.java:70-84)
+        algo = conf.get("heterogeneity.algorithm", "concentration")
+        return {"concentration": "concentrationCoeff",
+                "uncertainty": "uncertaintyCoeff"}.get(algo, algo)
+
+
+class BaggingSampler(Job):
+    """Bootstrap sample with replacement (BaggingSampler.java:100-122) —
+    row-level resampling of the raw CSV, batch by batch."""
+
+    name = "BaggingSampler"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        rows = read_input(input_path, delim=delim)
+        batch = conf.get_int("batch.size", 10_000)
+        key = jax.random.PRNGKey(conf.get_int("seed", 0))
+        out: List[str] = []
+        for s in range(0, rows.shape[0], batch):
+            chunk = rows[s:s + batch]
+            key, sub = jax.random.split(key)
+            idx = np.asarray(samplers.bootstrap_indices(sub, chunk.shape[0]))
+            out.extend(delim.join(chunk[i]) for i in idx)
+        write_output(output_path, out)
+        counters.set("Records", "Processed", int(rows.shape[0]))
+        counters.set("Records", "Emitted", len(out))
+
+
+class UnderSamplingBalancer(Job):
+    """Majority-class undersampler (UnderSamplingBalancer.java:92-164): keep
+    minority rows, thin majority rows to p = minCount/classCount."""
+
+    name = "UnderSamplingBalancer"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        schema = self.load_schema(conf)
+        rows = read_input(input_path, delim=delim)
+        class_ord = schema.class_field.ordinal
+        labels_raw = rows[:, class_ord]
+        values, inverse, cts = np.unique(
+            labels_raw.astype(str), return_inverse=True, return_counts=True)
+        import jax.numpy as jnp
+        key = jax.random.PRNGKey(conf.get_int("seed", 0))
+        mask = np.asarray(samplers.undersample_mask(
+            key, jnp.asarray(inverse.astype(np.int32)),
+            jnp.asarray(cts.astype(np.float32))))
+        out = [delim.join(rows[i]) for i in np.nonzero(mask)[0]]
+        write_output(output_path, out)
+        counters.set("Records", "Processed", int(rows.shape[0]))
+        counters.set("Records", "Emitted", len(out))

@@ -1,0 +1,102 @@
+"""Sequence-model jobs — Markov chain trainer, HMM builder, Viterbi predictor
+(markov/MarkovStateTransitionModel.java, HiddenMarkovModelBuilder.java,
+ViterbiStatePredictor.java).
+
+Input rows are ``id, token, token, ...`` sequences (the reference's
+Projection-extracted sequence files). Sub-token structure (``obs:state``)
+follows ``sub.field.delim``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.jobs.base import Job, read_input, read_lines, write_output
+from avenir_tpu.models import markov as mk
+from avenir_tpu.utils.metrics import Counters
+
+
+def _sequences(path: str, delim: str, skip: int = 1) -> List[List[str]]:
+    rows = read_input(path, delim=delim)
+    return [[t for t in row[skip:] if t != ""] for row in rows]
+
+
+class MarkovStateTransitionModel(Job):
+    """First-order transition matrix with Laplace smoothing; int-scaled rows
+    when ``trans.prob.scale`` > 1 (StateTransitionProbability.java:65-95)."""
+
+    name = "MarkovStateTransitionModel"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        skip = conf.get_int("skip.field.count", 1)
+        seqs = _sequences(input_path, delim, skip)
+        states = conf.get_list("model.states")
+        enc = mk.SequenceEncoder(states) if states else None
+        scale = conf.get_int("trans.prob.scale", 1)
+        model, enc = mk.MarkovChain(
+            laplace=conf.get_float("laplace.smoothing", 1.0),
+            scale=scale if scale > 1 else None).fit(seqs, encoder=enc)
+        write_output(output_path, model.to_lines(delim=conf.field_delim))
+        counters.set("Records", "Processed", len(seqs))
+
+
+class HiddenMarkovModelBuilder(Job):
+    """Supervised HMM estimation. Fully-tagged mode: tokens are
+    ``obs<sub>state``; partially-tagged mode (``partially.tagged=true``):
+    state names appear inline, surrounding observations attributed by the
+    ``window.function`` distance-decay weights
+    (HiddenMarkovModelBuilder.java:136-260)."""
+
+    name = "HiddenMarkovModelBuilder"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        sub = conf.get("sub.field.delim", ":")
+        skip = conf.get_int("skip.field.count", 1)
+        seqs = _sequences(input_path, delim, skip)
+        builder = mk.HMMBuilder(laplace=conf.get_float("laplace.smoothing", 1.0))
+        states = conf.get_list("model.states")
+        obs_vocab = conf.get_list("model.observations")
+        obs_enc = mk.SequenceEncoder(obs_vocab) if obs_vocab else None
+        if conf.get_bool("partially.tagged", False):
+            if not states:
+                raise ValueError("partially.tagged mode requires model.states")
+            window = conf.get_float_list("window.function", [1.0, 0.75, 0.5, 0.25])
+            model = builder.fit_partially_tagged(
+                seqs, states, window_function=window, obs_encoder=obs_enc)
+        else:
+            tagged = [[tuple(t.split(sub, 1)) for t in seq] for seq in seqs]
+            st_enc = mk.SequenceEncoder(states) if states else None
+            model = builder.fit_tagged(tagged, state_encoder=st_enc,
+                                       obs_encoder=obs_enc)
+        write_output(output_path, model.to_lines(delim=conf.field_delim))
+        counters.set("Records", "Processed", len(seqs))
+
+
+class ViterbiStatePredictor(Job):
+    """Decode rows of (id, obs...) to state paths; ``output.state.only``
+    controls plain-path vs ``obs:state`` pair output
+    (ViterbiStatePredictor.java:114-142)."""
+
+    name = "ViterbiStatePredictor"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        model_path = conf.get("hmm.model.file.path") or conf.get("model.file.path")
+        if not model_path:
+            raise ValueError("hmm.model.file.path not set")
+        model = mk.HMMModel.from_lines(read_lines(model_path),
+                                       delim=conf.field_delim)
+        pair_output = not conf.get_bool("output.state.only", True)
+        predictor = mk.ViterbiStatePredictor(model, pair_output=pair_output,
+                                             delim=conf.field_delim)
+        skip = conf.get_int("skip.field.count", 1)
+        rows = [[conf.field_delim.join(r[:skip])] + list(r[skip:])
+                for r in read_input(input_path, delim=delim)]
+        write_output(output_path, predictor.predict_lines(rows))
+        counters.set("Records", "Processed", len(rows))

@@ -1,0 +1,184 @@
+"""Decision-tree jobs.
+
+The reference grows a tree by alternating two MR jobs per node level —
+candidate-split evaluation (tree/SplitGenerator.java wrapping
+explore/ClassPartitionGenerator.java) and data partitioning into an HDFS
+directory tree (tree/DataPartitioner.java), with a human/script driving the
+recursion. Here:
+
+- :class:`ClassPartitionGenerator` emits scored candidate splits for one node
+  level (the reference's split-file contract);
+- :class:`DataPartitioner` applies the best split and writes
+  ``split=<key>/segment=<i>/data/partition.txt`` directories — the same
+  on-disk layout, for runbook continuity;
+- :class:`DecisionTreeBuilder` is the TPU-native replacement: the whole
+  recursion as one in-memory frontier loop (models/tree.py), emitting the
+  final tree as JSON. One process, zero intermediate files.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.jobs.base import Job, read_input, read_lines, write_output
+from avenir_tpu.models import tree as dtree
+from avenir_tpu.utils.metrics import Counters
+
+import jax.numpy as jnp
+
+
+def _tree_params(conf: JobConfig) -> dict:
+    return dict(
+        algorithm=conf.get("split.algorithm", "entropy"),
+        max_split=conf.get_int("max.cat.attr.split.groups",
+                               conf.get_int("max.split", 3)),
+        attr_strategy={"userSpecified": "userSpecified", "all": "all",
+                       "random": "randomK"}.get(
+            conf.get("split.attribute.selection.strategy", "all"), "all"),
+        user_attrs=conf.get_int_list("split.attributes"),
+        random_k=conf.get_int("random.split.set.size"),
+        top_n=conf.get_int("num.top.splits", 1),
+    )
+
+
+class ClassPartitionGenerator(Job):
+    """One-level candidate-split scoring: emits
+    ``attr;splitKey;stat[;segment class distributions]`` rows, the contract
+    DataPartitioner consumes (ClassPartitionGenerator.java:513-566)."""
+
+    name = "ClassPartitionGenerator"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        _enc, ds, _rows = self.encode_input(conf, input_path)
+        p = _tree_params(conf)
+        schema = self.load_schema(conf)
+        is_cat = [schema.field_by_ordinal(o).is_categorical
+                  for o in ds.binned_ordinals]
+        all_splits = dtree.generate_candidate_splits(ds, p["max_split"], is_cat)
+        labels = jnp.asarray(ds.labels)
+        node_ids = jnp.zeros(ds.num_rows, jnp.int32)
+        lines: List[str] = []
+        out_distr = conf.get_bool("output.split.prob", False)
+        for a, splits in sorted(all_splits.items()):
+            ordinal = ds.binned_ordinals[a]
+            for sp in splits:
+                seg_codes = sp.seg_of_bin[ds.codes[:, a]][:, None]    # [N, 1]
+                hist = dtree.split_node_histograms(
+                    jnp.asarray(seg_codes), node_ids, labels,
+                    sp.num_segments, 1, ds.num_classes)
+                score = float(np.asarray(
+                    dtree.split_scores(hist, p["algorithm"]))[0, 0])
+                row = [str(ordinal), sp.key, f"{score:.6f}"]
+                if out_distr:
+                    hh = np.asarray(hist)[0, :, 0, :]                 # [G, C]
+                    tot = np.maximum(hh.sum(-1, keepdims=True), 1e-9)
+                    for g in range(sp.num_segments):
+                        row.append(":".join(f"{v:.4f}" for v in (hh[g] / tot[g])))
+                lines.append(";".join(row))
+        write_output(output_path, lines)
+        counters.set("Records", "Processed", ds.num_rows)
+        counters.set("Splits", "Evaluated", len(lines))
+
+
+class SplitGenerator(ClassPartitionGenerator):
+    """Path-convention subclass (tree/SplitGenerator.java:39-54): reads
+    ``project.base.path``/``split.path`` to derive in/out dirs; writes the
+    candidate-splits file to the sibling ``splits`` dir."""
+
+    name = "SplitGenerator"
+
+    def run(self, conf: JobConfig, input_path: str = "", output_path: str = "") -> Counters:
+        base = conf.get("project.base.path", "")
+        rel = conf.get("split.path", "")
+        inp = input_path or os.path.join(base, rel, "data")
+        out = output_path or os.path.join(base, rel, "splits")
+        return super().run(conf, inp, out)
+
+
+class DataPartitioner(Job):
+    """Apply the best candidate split: reads the splits file
+    (``split.file.path`` or ``<input>/../splits``), selects best or
+    random-from-top-N (DataPartitioner.java:157-201), and writes each
+    record into ``split=<attr>/segment=<seg>/data/partition.txt`` under the
+    output dir (:114-129)."""
+
+    name = "DataPartitioner"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        splits_path = conf.get("split.file.path") or os.path.join(
+            os.path.dirname(input_path.rstrip(os.sep)), "splits")
+        rows_split = [ln.split(";") for ln in read_lines(splits_path)
+                      if not ln.startswith("featureScore")]
+        scored = sorted(((float(r[2]), int(r[0]), r[1]) for r in rows_split),
+                        reverse=True)
+        top_n = conf.get_int("num.top.splits", 1)
+        strategy = conf.get("split.selection.strategy", "best")
+        rng = np.random.default_rng(conf.get_int("seed", 0))
+        pick = scored[0] if strategy == "best" or top_n <= 1 else \
+            scored[int(rng.integers(min(top_n, len(scored))))]
+        _score, attr_ord, key = pick
+
+        enc, ds, rows = self.encode_input(conf, input_path)
+        schema = self.load_schema(conf)
+        is_cat = [schema.field_by_ordinal(o).is_categorical
+                  for o in ds.binned_ordinals]
+        all_splits = dtree.generate_candidate_splits(
+            ds, _tree_params(conf)["max_split"], is_cat)
+        a = ds.binned_ordinals.index(attr_ord)
+        sp = next((s for s in all_splits[a] if s.key == key), None)
+        if sp is None:
+            raise ValueError(f"split key {key!r} not found for attribute {attr_ord}")
+        segs = sp.seg_of_bin[ds.codes[:, a]]
+        for g in range(sp.num_segments):
+            seg_dir = os.path.join(output_path, f"split={attr_ord}",
+                                   f"segment={g}", "data")
+            os.makedirs(seg_dir, exist_ok=True)
+            with open(os.path.join(seg_dir, "partition.txt"), "w") as fh:
+                for i in np.nonzero(segs == g)[0]:
+                    fh.write(delim.join(rows[i]))
+                    fh.write("\n")
+        counters.set("Records", "Processed", ds.num_rows)
+        counters.set("Splits", "Segments", int(sp.num_segments))
+
+
+class DecisionTreeBuilder(Job):
+    """Whole-tree induction in one job (the in-memory frontier loop that
+    replaces the per-level SplitGenerator/DataPartitioner alternation).
+    Output: the tree as a one-line JSON model plus, in validation mode,
+    confusion counters."""
+
+    name = "DecisionTreeBuilder"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        _enc, ds, _rows = self.encode_input(conf, input_path)
+        schema = self.load_schema(conf)
+        is_cat = [schema.field_by_ordinal(o).is_categorical
+                  for o in ds.binned_ordinals]
+        p = _tree_params(conf)
+        trainer = dtree.DecisionTree(
+            algorithm=p["algorithm"], max_split=p["max_split"],
+            attr_strategy=p["attr_strategy"], user_attrs=p["user_attrs"],
+            random_k=p["random_k"], top_n=p["top_n"],
+            max_depth=conf.get_int("max.depth", 4),
+            min_node_size=conf.get_int("min.node.size", 32),
+            seed=conf.get_int("seed", 0),
+        )
+        model = trainer.fit(ds, is_cat)
+        write_output(output_path, [model.to_string()])
+        if conf.get("prediction.mode") == "validation":
+            _pred, _distr, cm, c2 = trainer.predict(
+                model, ds, validate=True,
+                pos_class=conf.get("positive.class.value"))
+            for group, vals in c2.as_dict().items():
+                for k, v in vals.items():
+                    counters.set(group, k, v)
+        counters.set("Records", "Processed", ds.num_rows)
+        counters.set("Tree", "Nodes", len(model.nodes))

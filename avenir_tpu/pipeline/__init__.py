@@ -1,3 +1,4 @@
+from avenir_tpu.pipeline.driver import Pipeline, Stage, decision_tree_pipeline, knn_pipeline
 from avenir_tpu.pipeline.streaming import (
     InProcQueue,
     QueueActionWriter,
@@ -8,8 +9,12 @@ from avenir_tpu.pipeline.streaming import (
 
 __all__ = [
     "InProcQueue",
+    "Pipeline",
     "QueueActionWriter",
     "QueueRewardReader",
     "QueueEventSource",
     "ReinforcementLearnerServer",
+    "Stage",
+    "decision_tree_pipeline",
+    "knn_pipeline",
 ]

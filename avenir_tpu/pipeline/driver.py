@@ -1,0 +1,145 @@
+"""In-process pipeline driver — the L4 layer the reference leaves to shell
+scripts and humans.
+
+The reference's multi-stage pipelines are bash verbs staging files through
+HDFS (resource/knn.sh:16-137) or runbook steps a human executes
+(resource/price_optimize_tutorial.txt:73-78). Here a :class:`Pipeline` is an
+ordered DAG of named stages over a shared artifact workspace: each stage is a
+job (from avenir_tpu.jobs) bound to input/output artifact names, and the
+driver resolves artifact paths, runs stages in dependency order, and collects
+per-stage counters. :func:`knn_pipeline` reproduces knn.sh end-to-end in one
+process.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.jobs import get_job
+from avenir_tpu.utils.metrics import Counters
+
+
+@dataclass
+class Stage:
+    """One pipeline step: a registered job name (or a callable with the job
+    ``run`` signature), the artifact it reads, the artifact it writes, and
+    per-stage property overrides."""
+
+    name: str
+    job: str | Callable[[JobConfig, str, str], Counters]
+    input: str
+    output: str
+    props: Dict[str, str] = field(default_factory=dict)
+    # artifacts this stage consumes via config paths (dependency edges only)
+    uses: Sequence[str] = ()
+
+    def run(self, conf: JobConfig, in_path: str, out_path: str) -> Counters:
+        runner = get_job(self.job).run if isinstance(self.job, str) else self.job
+        return runner(conf, in_path, out_path)
+
+
+class Pipeline:
+    """Artifact-addressed stage runner.
+
+    Artifacts are named paths in a workspace directory; ``bind`` points a
+    name at an existing external path (the input dataset, a schema file).
+    ``run`` executes stages in order, skipping any whose output artifact
+    already exists when ``resume=True`` — the free checkpoint/resume the
+    reference got from durable HDFS staging dirs, kept deliberately.
+    """
+
+    def __init__(self, workspace: str, conf: JobConfig,
+                 stages: Optional[List[Stage]] = None):
+        self.workspace = workspace
+        self.conf = conf
+        self.stages: List[Stage] = list(stages or [])
+        self.bindings: Dict[str, str] = {}
+        self.counters: Dict[str, Counters] = {}
+        os.makedirs(workspace, exist_ok=True)
+
+    def add(self, stage: Stage) -> "Pipeline":
+        self.stages.append(stage)
+        return self
+
+    def bind(self, artifact: str, path: str) -> "Pipeline":
+        self.bindings[artifact] = path
+        return self
+
+    def path(self, artifact: str) -> str:
+        if artifact in self.bindings:
+            return self.bindings[artifact]
+        return os.path.join(self.workspace, artifact)
+
+    def _deps(self, stage: Stage) -> List[str]:
+        """Artifacts a stage consumes: its input, declared ``uses``, and any
+        ``@artifact`` references in its property overrides."""
+        deps = [stage.input] + list(stage.uses)
+        deps += [v[1:] for v in stage.props.values()
+                 if isinstance(v, str) and v.startswith("@")]
+        return deps
+
+    def run(self, only: Optional[Sequence[str]] = None,
+            resume: bool = False) -> Dict[str, Counters]:
+        if only is None:
+            todo = list(self.stages)
+        else:
+            # transitive closure over artifact edges: a requested stage pulls
+            # in the producers of every artifact it consumes
+            producers = {s.output: s for s in self.stages}
+            needed = {name: True for name in only}
+            frontier = [s for s in self.stages if s.name in needed]
+            while frontier:
+                stage = frontier.pop()
+                for art in self._deps(stage):
+                    prod = producers.get(art)
+                    if prod is not None and prod.name not in needed:
+                        needed[prod.name] = True
+                        frontier.append(prod)
+            todo = [s for s in self.stages if s.name in needed]
+        for stage in todo:
+            out = self.path(stage.output)
+            if resume and os.path.exists(out):
+                continue
+            conf = JobConfig(dict(self.conf.props), prefix=self.conf.prefix)
+            for k, v in stage.props.items():
+                # per-stage overrides may reference artifacts as @name
+                if isinstance(v, str) and v.startswith("@"):
+                    v = self.path(v[1:])
+                conf.set(k, v)
+            self.counters[stage.name] = stage.run(conf, self.path(stage.input), out)
+        return self.counters
+
+
+def knn_pipeline(workspace: str, conf: JobConfig, train_path: str,
+                 test_path: str, class_cond: bool = False) -> Pipeline:
+    """resource/knn.sh as a DAG: [bayesianDistr → bayesianPredictor →] the
+    in-memory kNN classifier (which fuses computeDistance / joinFeatureDistr /
+    knnClassifier into one device pass)."""
+    p = Pipeline(workspace, conf)
+    p.bind("train", train_path)
+    p.bind("test", test_path)
+    if class_cond:
+        p.add(Stage("bayesianDistr", "BayesianDistribution", "train", "bayes_model"))
+        p.add(Stage("knnClassifier", "NearestNeighbor", "test", "predictions",
+                    props={"training.data.path": "@train",
+                           "class.condition.weighted": "true",
+                           "bayesian.model.file.path": "@bayes_model"},
+                    uses=("bayes_model",)))
+    else:
+        p.add(Stage("knnClassifier", "NearestNeighbor", "test", "predictions",
+                    props={"training.data.path": "@train"}))
+    return p
+
+
+def decision_tree_pipeline(workspace: str, conf: JobConfig,
+                           data_path: str) -> Pipeline:
+    """The SplitGenerator/DataPartitioner runbook as one stage (the in-memory
+    frontier loop) plus the per-level artifacts for parity inspection."""
+    p = Pipeline(workspace, conf)
+    p.bind("data", data_path)
+    p.add(Stage("splitGenerator", "ClassPartitionGenerator", "data", "splits"))
+    p.add(Stage("treeBuilder", "DecisionTreeBuilder", "data", "tree"))
+    return p

@@ -1,0 +1,330 @@
+"""Jobs-layer tests — the reference's Tool/CLI contract on the in-process
+engine: CSV in, CSV out, properties + JSON schema, counters."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.csv_io import write_csv
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.datagen.elearn import ELEARN_SCHEMA_JSON, generate_elearn
+from avenir_tpu.datagen.retarget import RETARGET_SCHEMA_JSON, generate_retarget
+from avenir_tpu.jobs import REGISTRY, get_job
+from avenir_tpu.jobs.base import read_lines
+
+
+@pytest.fixture(scope="module")
+def churn_env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("churn")
+    rows = generate_churn(2000, seed=7)
+    write_csv(str(root / "train.csv"), rows[:1600])
+    write_csv(str(root / "test.csv"), rows[1600:])
+    schema = root / "churn.json"
+    schema.write_text(json.dumps(CHURN_SCHEMA_JSON))
+    conf = JobConfig({"feature.schema.file.path": str(schema)})
+    return root, conf
+
+
+def test_registry_has_reference_names():
+    # every reference Tool family is addressable by fq class name
+    for fq in [
+        "org.avenir.bayesian.BayesianDistribution",
+        "org.avenir.explore.MutualInformation",
+        "org.avenir.knn.NearestNeighbor",
+        "org.avenir.markov.HiddenMarkovModelBuilder",
+        "org.avenir.regress.LogisticRegressionJob",
+        "org.avenir.discriminant.FisherDiscriminant",
+        "org.avenir.reinforce.GreedyRandomBandit",
+        "org.avenir.text.WordCounter",
+        "org.avenir.tree.DataPartitioner",
+    ]:
+        assert fq in REGISTRY
+
+
+def test_bayesian_train_predict_jobs(churn_env):
+    root, conf = churn_env
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(root / "model"))
+    assert read_lines(str(root / "model"))
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("bayesian.model.file.path", str(root / "model"))
+    conf2.set("prediction.mode", "validation")
+    conf2.set("positive.class.value", "closed")
+    c = get_job("BayesianPredictor").run(conf2, str(root / "test.csv"),
+                                         str(root / "pred"))
+    out = read_lines(str(root / "pred"))
+    assert len(out) == 400
+    assert all(ln.rsplit(",", 1)[1] in ("open", "closed", "ambiguous") for ln in out)
+    acc = c.get("Validation", "accuracy")
+    assert acc >= 60   # planted churn drivers are learnable
+
+
+def test_bayesian_feature_prob_output(churn_env):
+    root, conf = churn_env
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("bayesian.model.file.path", str(root / "model"))
+    conf2.set("output.feature.prob.only", "true")
+    get_job("BayesianPredictor").run(conf2, str(root / "test.csv"),
+                                     str(root / "featprob"))
+    lines = read_lines(str(root / "featprob"))
+    assert len(lines) == 400 * 2    # one row per record per class
+    rid, cv, p = lines[0].split(",")
+    assert cv in ("open", "closed") and 0.0 <= float(p) <= 1.0
+
+
+def test_mutual_information_job(churn_env):
+    root, conf = churn_env
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("mutual.info.score.algorithms", "mim,mrmr")
+    get_job("MutualInformation").run(conf2, str(root / "train.csv"),
+                                     str(root / "mi"))
+    lines = read_lines(str(root / "mi"))
+    assert any(ln.startswith("featureScore:mim") for ln in lines)
+    assert any(ln.startswith("featureScore:mrmr") for ln in lines)
+
+
+def test_cramer_job_recovers_drivers(churn_env):
+    root, conf = churn_env
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("dest.attributes", "6")     # class ordinal → against-class mode
+    get_job("CramerCorrelation").run(conf2, str(root / "train.csv"),
+                                     str(root / "cramer"))
+    lines = read_lines(str(root / "cramer"))
+    assert len(lines) == 5                # 5 features vs class
+    stats = {ln.split(",")[0]: float(ln.split(",")[2]) for ln in lines}
+    # usage drivers should dominate account age
+    assert stats["minUsed"] > stats["acctAge"]
+
+
+def test_sampler_jobs(churn_env):
+    root, conf = churn_env
+    c = get_job("BaggingSampler").run(conf, str(root / "train.csv"),
+                                      str(root / "bagged"))
+    assert c.get("Records", "Emitted") == 1600
+    c2 = get_job("UnderSamplingBalancer").run(conf, str(root / "train.csv"),
+                                              str(root / "balanced"))
+    assert 0 < c2.get("Records", "Emitted") < 1600
+
+
+@pytest.fixture(scope="module")
+def retarget_env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("retarget")
+    rows = generate_retarget(3000, seed=3)
+    write_csv(str(root / "data.csv"), rows)
+    schema = root / "retarget.json"
+    schema.write_text(json.dumps(RETARGET_SCHEMA_JSON))
+    return root, JobConfig({"feature.schema.file.path": str(schema)})
+
+
+def test_split_generator_and_partitioner(retarget_env):
+    root, conf = retarget_env
+    get_job("ClassPartitionGenerator").run(conf, str(root / "data.csv"),
+                                           str(root / "splits"))
+    split_lines = read_lines(str(root / "splits"))
+    assert split_lines
+    best = max(split_lines, key=lambda ln: float(ln.split(";")[2]))
+    assert best.split(";")[0] == "1"      # campaignType drives conversion
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("split.file.path", str(root / "splits"))
+    c = get_job("DataPartitioner").run(conf2, str(root / "data.csv"),
+                                       str(root / "parts"))
+    segs = c.get("Splits", "Segments")
+    assert segs >= 2
+    # MR-layout partition dirs, records conserved
+    total = 0
+    for g in range(segs):
+        part = root / "parts" / "split=1" / f"segment={g}" / "data" / "partition.txt"
+        assert part.exists()
+        total += sum(1 for _ in open(part))
+    assert total == 3000
+
+
+def test_decision_tree_builder_job(retarget_env):
+    root, conf = retarget_env
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("prediction.mode", "validation")
+    conf2.set("positive.class.value", "Y")
+    c = get_job("DecisionTreeBuilder").run(conf2, str(root / "data.csv"),
+                                           str(root / "tree"))
+    assert c.get("Tree", "Nodes") >= 3
+    assert c.get("Validation", "accuracy") >= 55
+
+
+@pytest.fixture(scope="module")
+def elearn_env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("elearn")
+    rows = generate_elearn(1500, seed=5)
+    write_csv(str(root / "train.csv"), rows[:1200])
+    write_csv(str(root / "test.csv"), rows[1200:])
+    schema = root / "elearn.json"
+    schema.write_text(json.dumps(ELEARN_SCHEMA_JSON))
+    conf = JobConfig({"feature.schema.file.path": str(schema),
+                      "training.data.path": str(root / "train.csv")})
+    return root, conf
+
+
+def test_same_type_similarity_job(elearn_env):
+    root, conf = elearn_env
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("top.match.count", "5")
+    get_job("SameTypeSimilarity").run(conf2, str(root / "test.csv"),
+                                      str(root / "dist"))
+    lines = read_lines(str(root / "dist"))
+    assert len(lines) == 300 * 5
+    _t, _r, d = lines[0].split(",")
+    assert int(d) >= 0
+
+
+def test_feature_cond_prob_joiner_job(elearn_env, churn_env, tmp_path):
+    # join works on any (testId, trainId, dist) + (trainId, class, prob) files
+    dist = tmp_path / "dist"
+    probs = tmp_path / "probs"
+    dist.mkdir(); probs.mkdir()
+    (dist / "part-00000").write_text("t1,r1,100\nt1,r2,200\n")
+    (probs / "part-00000").write_text("r1,Y,0.9\nr1,N,0.1\nr2,Y,0.4\nr2,N,0.6\n")
+    conf = JobConfig({"feature.prob.file.path": str(probs)})
+    get_job("FeatureCondProbJoiner").run(conf, str(dist), str(tmp_path / "joined"))
+    joined = read_lines(str(tmp_path / "joined"))
+    assert joined[0] == "t1,r1,100,Y,0.9,N,0.1"
+
+
+def test_nearest_neighbor_job_validation(elearn_env):
+    root, conf = elearn_env
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("top.match.count", "15")
+    conf2.set("kernel.function", "gaussian")
+    conf2.set("validation.mode", "true")
+    conf2.set("positive.class.value", "F")
+    c = get_job("NearestNeighbor").run(conf2, str(root / "test.csv"),
+                                       str(root / "knnpred"))
+    assert c.get("Validation", "accuracy") >= 60
+
+
+def test_logistic_regression_job_with_resume(churn_env, tmp_path):
+    root, conf = churn_env
+    coeff = tmp_path / "coeff" / "history.txt"
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("coeff.file.path", str(coeff))
+    conf2.set("iteration.limit", "5")
+    c1 = get_job("LogisticRegressionJob").run(conf2, str(root / "train.csv"),
+                                              str(tmp_path / "lr1"))
+    assert c1.get("Iterations", "Run") == 5
+    n_lines = len(read_lines(str(coeff)))
+    assert n_lines == 5
+    # resume continues from the history file (reference driver-loop contract)
+    conf2.set("iteration.limit", "10")
+    get_job("LogisticRegressionJob").run(conf2, str(root / "train.csv"),
+                                         str(tmp_path / "lr2"))
+    assert len(read_lines(str(coeff))) > n_lines
+
+
+def test_fisher_job(elearn_env):
+    root, conf = elearn_env
+    get_job("FisherDiscriminant").run(conf, str(root / "train.csv"),
+                                      str(root / "fisher"))
+    lines = read_lines(str(root / "fisher"))
+    assert len(lines) == 9    # one row per continuous attribute
+
+
+def test_bandit_round_jobs(tmp_path):
+    rows = [["g1", "a", "10", "0.2"], ["g1", "b", "10", "0.9"],
+            ["g2", "x", "5", "0.5"], ["g2", "y", "5", "0.1"]]
+    inp = tmp_path / "state"
+    inp.mkdir()
+    write_csv(str(inp / "part-00000"), rows)
+    for name, extra in [("GreedyRandomBandit", {"prob.reduction.algorithm": "linear",
+                                                "current.round.num": "50"}),
+                        ("AuerDeterministic", {}),
+                        ("SoftMaxBandit", {"temp.constant": "0.05"}),
+                        ("RandomFirstGreedyBandit", {"current.round.num": "100"})]:
+        conf = JobConfig(dict(extra))
+        out = tmp_path / f"sel_{name}"
+        c = get_job(name).run(conf, str(inp), str(out))
+        lines = read_lines(str(out))
+        assert len(lines) == 2
+        sel = dict(ln.split(",") for ln in lines)
+        assert set(sel) == {"g1", "g2"}
+        if name in ("AuerDeterministic", "SoftMaxBandit",
+                    "RandomFirstGreedyBandit", "GreedyRandomBandit"):
+            # late rounds exploit: best arms dominate
+            assert sel["g1"] == "b"
+
+
+def test_word_counter_job(tmp_path):
+    inp = tmp_path / "docs"
+    inp.mkdir()
+    (inp / "a.txt").write_text("1,TPU systolic arrays\n2,TPU matmul throughput\n")
+    conf = JobConfig({"text.field.ordinal": "1"})
+    c = get_job("WordCounter").run(conf, str(inp), str(tmp_path / "wc"))
+    counts = dict(ln.rsplit(",", 1) for ln in read_lines(str(tmp_path / "wc")))
+    assert counts["tpu"] == "2"
+    assert c.get("Words", "Distinct") == int(len(counts))
+
+
+def test_cli_main(churn_env, tmp_path, capsys):
+    from avenir_tpu.__main__ import main
+    root, conf = churn_env
+    props = tmp_path / "job.properties"
+    props.write_text(
+        f"feature.schema.file.path={conf.get('feature.schema.file.path')}\n")
+    rc = main(["org.avenir.bayesian.BayesianDistribution",
+               f"-Dconf.path={props}", str(root / "train.csv"),
+               str(tmp_path / "cli_model")])
+    assert rc == 0
+    assert "Records" in capsys.readouterr().out
+    assert read_lines(str(tmp_path / "cli_model"))
+
+
+def test_knn_pipeline_driver(elearn_env, tmp_path):
+    from avenir_tpu.pipeline import knn_pipeline
+    root, conf = elearn_env
+    p = knn_pipeline(str(tmp_path / "ws"), conf, str(root / "train.csv"),
+                     str(root / "test.csv"), class_cond=False)
+    counters = p.run()
+    assert "knnClassifier" in counters
+    preds = read_lines(p.path("predictions"))
+    assert len(preds) == 300
+    # resume skips completed stages
+    before = os.path.getmtime(os.path.join(p.path("predictions"), "part-00000"))
+    p.run(resume=True)
+    assert os.path.getmtime(os.path.join(p.path("predictions"), "part-00000")) == before
+
+
+def test_nearest_neighbor_regression_modes(elearn_env, tmp_path):
+    root, conf = elearn_env
+    for method, extra in [("average", {}), ("median", {}),
+                          ("linear", {"regression.input.var.ordinal": "6"})]:
+        conf2 = JobConfig(dict(conf.props))
+        conf2.set("prediction.mode", "regression")
+        conf2.set("regression.method", method)
+        conf2.set("regression.target.ordinal", "5")     # testScore
+        for k, v in extra.items():
+            conf2.set(k, v)
+        out = tmp_path / f"regr_{method}"
+        get_job("NearestNeighbor").run(conf2, str(root / "test.csv"), str(out))
+        preds = [float(ln.rsplit(",", 1)[1]) for ln in read_lines(str(out))]
+        assert len(preds) == 300
+        assert all(np.isfinite(p) for p in preds)
+
+
+def test_nearest_neighbor_regression_requires_target(elearn_env, tmp_path):
+    root, conf = elearn_env
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("prediction.mode", "regression")
+    with pytest.raises(ValueError, match="regression.target.ordinal"):
+        get_job("NearestNeighbor").run(conf2, str(root / "test.csv"),
+                                       str(tmp_path / "regr_bad"))
+
+
+def test_pipeline_dependency_closure(elearn_env, tmp_path):
+    from avenir_tpu.pipeline import knn_pipeline
+    root, conf = elearn_env
+    p = knn_pipeline(str(tmp_path / "ws2"), conf, str(root / "train.csv"),
+                     str(root / "test.csv"), class_cond=True)
+    # requesting only the classifier must pull in its bayes-model producer
+    counters = p.run(only=["knnClassifier"])
+    assert "bayesianDistr" in counters and "knnClassifier" in counters
+    assert len(read_lines(p.path("predictions"))) == 300

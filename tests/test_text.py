@@ -1,0 +1,70 @@
+"""Text analysis tests — tokenizer, Porter stemmer, word count."""
+
+from avenir_tpu.text import WordCount, porter_stem, tokenize
+
+
+def test_tokenize_lowercase_stopwords():
+    toks = tokenize("The quick brown Fox, jumped over THE lazy dog!")
+    assert "the" not in toks
+    assert toks == ["quick", "brown", "fox", "jumped", "over", "lazy", "dog"]
+
+
+def test_tokenize_keep_stopwords():
+    toks = tokenize("to be or not", stopwords=False)
+    assert toks == ["to", "be", "or", "not"]
+
+
+def test_porter_classic_vectors():
+    # canonical examples from Porter (1980)
+    vectors = {
+        "caresses": "caress", "ponies": "poni", "caress": "caress",
+        "cats": "cat", "feed": "feed", "agreed": "agre",
+        "plastered": "plaster", "motoring": "motor", "sing": "sing",
+        "conflated": "conflat", "troubled": "troubl", "sized": "size",
+        "hopping": "hop", "tanned": "tan", "falling": "fall",
+        "hissing": "hiss", "fizzed": "fizz", "failing": "fail",
+        "filing": "file", "happy": "happi", "sky": "sky",
+        "relational": "relat", "conditional": "condit", "rational": "ration",
+        "valenci": "valenc", "hesitanci": "hesit", "digitizer": "digit",
+        "conformabli": "conform", "radicalli": "radic", "differentli": "differ",
+        "vileli": "vile", "analogousli": "analog", "vietnamization": "vietnam",
+        "predication": "predic", "operator": "oper", "feudalism": "feudal",
+        "decisiveness": "decis", "hopefulness": "hope", "callousness": "callous",
+        "formaliti": "formal", "sensitiviti": "sensit", "sensibiliti": "sensibl",
+        "triplicate": "triplic", "formative": "form", "formalize": "formal",
+        "electriciti": "electr", "electrical": "electr", "hopeful": "hope",
+        "goodness": "good", "revival": "reviv", "allowance": "allow",
+        "inference": "infer", "airliner": "airlin", "gyroscopic": "gyroscop",
+        "adjustable": "adjust", "defensible": "defens", "irritant": "irrit",
+        "replacement": "replac", "adjustment": "adjust", "dependent": "depend",
+        "adoption": "adopt", "homologou": "homolog", "communism": "commun",
+        "activate": "activ", "angulariti": "angular", "homologous": "homolog",
+        "effective": "effect", "bowdlerize": "bowdler",
+        "probate": "probat", "rate": "rate", "cease": "ceas",
+        "controll": "control", "roll": "roll",
+    }
+    for word, want in vectors.items():
+        assert porter_stem(word) == want, (word, porter_stem(word), want)
+
+
+def test_wordcount_counts_and_top():
+    wc = WordCount()
+    wc.add_lines(["hello world hello", "world world again"])
+    d = dict(wc.items())
+    assert d == {"hello": 2, "world": 3, "again": 1}
+    assert wc.top(1) == [("world", 3)]
+
+
+def test_wordcount_streaming_vocab_growth():
+    wc = WordCount()
+    wc.add_lines(["alpha beta"])
+    wc.add_lines(["beta gamma gamma"])
+    d = dict(wc.items())
+    assert d == {"alpha": 1, "beta": 2, "gamma": 2}
+
+
+def test_wordcount_stemming_merges_forms():
+    wc = WordCount(stem=True)
+    wc.add_lines(["running runs ran", "run runner"])
+    d = dict(wc.items())
+    assert d["run"] >= 3   # running/runs/run collapse
