@@ -105,6 +105,4 @@ class BayesianPredictor(Job):
         write_output(output_path, out)
         counters.set("Records", "Processed", ds.num_rows)
         if result.counters is not None:
-            for group, vals in result.counters.as_dict().items():
-                for k, v in vals.items():
-                    counters.set(group, k, v)
+            counters.merge(result.counters)

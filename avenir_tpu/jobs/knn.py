@@ -55,15 +55,8 @@ class SameTypeSimilarity(Job):
                else [str(i) for i in range(test_ds.num_rows)])
         lines = mknn.pairwise_distance_lines(
             model, test_ds, [str(i) for i in ids], k,
-            distance_scale=conf.get_int("distance.scale", 1000), delim=delim)
-        # carry true train ids if present
-        if train_ds.ids is not None:
-            tid = [str(v) for v in train_ds.ids]
-            fixed = []
-            for ln in lines:
-                t, r, d = ln.split(delim)
-                fixed.append(delim.join([t, tid[int(r)], d]))
-            lines = fixed
+            distance_scale=conf.get_int("distance.scale", 1000), delim=delim,
+            ref_ids=train_ds.ids)
         write_output(output_path, lines)
         counters.set("Records", "Test", test_ds.num_rows)
         counters.set("Records", "Train", train_ds.num_rows)
@@ -171,8 +164,6 @@ class NearestNeighbor(Job):
                 out.append(delim.join(
                     list(row) + [train_ds.class_values[int(result.predicted[i])]]))
             if result.counters is not None:
-                for group, vals in result.counters.as_dict().items():
-                    for k, v in vals.items():
-                        counters.set(group, k, v)
+                counters.merge(result.counters)
         write_output(output_path, out)
         counters.set("Records", "Processed", test_ds.num_rows)

@@ -12,14 +12,27 @@ from __future__ import annotations
 from typing import List
 
 from avenir_tpu.core.config import JobConfig
-from avenir_tpu.jobs.base import Job, read_input, read_lines, write_output
+from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import markov as mk
 from avenir_tpu.utils.metrics import Counters
 
 
+def _seq_rows(path: str, delim: str) -> List[List[str]]:
+    """Sequence files are naturally ragged (one row per record, variable
+    length) — read raw lines, not the rectangular CSV reader."""
+    from avenir_tpu.jobs.base import input_files
+    rows: List[List[str]] = []
+    for f in input_files(path):
+        with open(f) as fh:
+            for line in fh:
+                line = line.rstrip("\n").rstrip("\r")
+                if line:
+                    rows.append(line.split(delim))
+    return rows
+
+
 def _sequences(path: str, delim: str, skip: int = 1) -> List[List[str]]:
-    rows = read_input(path, delim=delim)
-    return [[t for t in row[skip:] if t != ""] for row in rows]
+    return [[t for t in row[skip:] if t != ""] for row in _seq_rows(path, delim)]
 
 
 class MarkovStateTransitionModel(Job):
@@ -97,6 +110,6 @@ class ViterbiStatePredictor(Job):
                                              delim=conf.field_delim)
         skip = conf.get_int("skip.field.count", 1)
         rows = [[conf.field_delim.join(r[:skip])] + list(r[skip:])
-                for r in read_input(input_path, delim=delim)]
+                for r in _seq_rows(input_path, delim)]
         write_output(output_path, predictor.predict_lines(rows))
         counters.set("Records", "Processed", len(rows))

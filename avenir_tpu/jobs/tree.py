@@ -64,22 +64,31 @@ class ClassPartitionGenerator(Job):
         node_ids = jnp.zeros(ds.num_rows, jnp.int32)
         lines: List[str] = []
         out_distr = conf.get_bool("output.split.prob", False)
+        split_chunk = conf.get_int("split.chunk", 128)
         for a, splits in sorted(all_splits.items()):
             ordinal = ds.binned_ordinals[a]
-            for sp in splits:
-                seg_codes = sp.seg_of_bin[ds.codes[:, a]][:, None]    # [N, 1]
+            col = ds.codes[:, a]
+            # batched scoring: [N, S] segment codes per chunk, one contraction
+            # (the same path DecisionTree.fit uses)
+            for s0 in range(0, len(splits), split_chunk):
+                chunk = splits[s0:s0 + split_chunk]
+                seg_tab = np.stack([sp.seg_of_bin for sp in chunk])   # [S, B]
+                seg_codes = seg_tab[:, col].T                         # [N, S]
+                gmax = max(sp.num_segments for sp in chunk)
                 hist = dtree.split_node_histograms(
                     jnp.asarray(seg_codes), node_ids, labels,
-                    sp.num_segments, 1, ds.num_classes)
-                score = float(np.asarray(
-                    dtree.split_scores(hist, p["algorithm"]))[0, 0])
-                row = [str(ordinal), sp.key, f"{score:.6f}"]
-                if out_distr:
-                    hh = np.asarray(hist)[0, :, 0, :]                 # [G, C]
-                    tot = np.maximum(hh.sum(-1, keepdims=True), 1e-9)
-                    for g in range(sp.num_segments):
-                        row.append(":".join(f"{v:.4f}" for v in (hh[g] / tot[g])))
-                lines.append(";".join(row))
+                    gmax, 1, ds.num_classes)
+                scores = np.asarray(dtree.split_scores(hist, p["algorithm"]))
+                hist_np = np.asarray(hist) if out_distr else None
+                for si, sp in enumerate(chunk):
+                    row = [str(ordinal), sp.key, f"{float(scores[si, 0]):.6f}"]
+                    if out_distr:
+                        hh = hist_np[si, :, 0, :]                     # [G, C]
+                        tot = np.maximum(hh.sum(-1, keepdims=True), 1e-9)
+                        for g in range(sp.num_segments):
+                            row.append(":".join(
+                                f"{v:.4f}" for v in (hh[g] / tot[g])))
+                    lines.append(";".join(row))
         write_output(output_path, lines)
         counters.set("Records", "Processed", ds.num_rows)
         counters.set("Splits", "Evaluated", len(lines))
@@ -129,9 +138,9 @@ class DataPartitioner(Job):
         schema = self.load_schema(conf)
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]
-        all_splits = dtree.generate_candidate_splits(
-            ds, _tree_params(conf)["max_split"], is_cat)
         a = ds.binned_ordinals.index(attr_ord)
+        all_splits = dtree.generate_candidate_splits(
+            ds, _tree_params(conf)["max_split"], is_cat, attrs=[a])
         sp = next((s for s in all_splits[a] if s.key == key), None)
         if sp is None:
             raise ValueError(f"split key {key!r} not found for attribute {attr_ord}")
@@ -177,8 +186,6 @@ class DecisionTreeBuilder(Job):
             _pred, _distr, cm, c2 = trainer.predict(
                 model, ds, validate=True,
                 pos_class=conf.get("positive.class.value"))
-            for group, vals in c2.as_dict().items():
-                for k, v in vals.items():
-                    counters.set(group, k, v)
+            counters.merge(c2)
         counters.set("Records", "Processed", ds.num_rows)
         counters.set("Tree", "Nodes", len(model.nodes))

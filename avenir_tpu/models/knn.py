@@ -317,12 +317,16 @@ class KNN:
 def pairwise_distance_lines(
     model: KNNModel, test: EncodedDataset, test_ids: Sequence[str],
     k: int, distance_scale: int = 1000, delim: str = ",",
-    metric: str = "euclidean",
+    metric: str = "euclidean", ref_ids: Optional[Sequence[str]] = None,
 ) -> List[str]:
     """(testID, refID, scaledIntDistance) rows — the record-pair distance
-    file format the reference's pipeline stages exchange."""
+    file format the reference's pipeline stages exchange. ``ref_ids``
+    defaults to reference-row indices."""
     dists, idx = nearest_neighbors(model, test, k, metric)
-    ref_ids = [str(i) for i in range(model.num_refs)]
+    if ref_ids is None:
+        ref_ids = [str(i) for i in range(model.num_refs)]
+    else:
+        ref_ids = [str(r) for r in ref_ids]
     lines = []
     for m, tid in enumerate(test_ids):
         for j in range(k):

@@ -1,0 +1,5 @@
+"""Runtime: native C++ data plane + device feeder."""
+
+from avenir_tpu.runtime.feeder import DeviceFeeder, prefetch_encoded
+
+__all__ = ["DeviceFeeder", "prefetch_encoded"]

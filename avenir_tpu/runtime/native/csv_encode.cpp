@@ -1,0 +1,234 @@
+// Native data plane: one-pass CSV parse + schema-driven encode.
+//
+// The reference's record pipeline is the JVM: Hadoop TextInputFormat splits
+// lines, every mapper re-splits and re-parses each record's fields
+// (e.g. bayesian/BayesianDistribution.java:137-179). Here the equivalent
+// hot path — CSV bytes -> int bin codes / float features / class labels —
+// is a C++ kernel invoked via ctypes, feeding fixed-shape numpy buffers that
+// go straight to TPU infeed. The Python DatasetEncoder
+// (core/encoding.py) remains the portable fallback and the source of truth
+// for vocab/bin semantics; this kernel implements the identical rules:
+//   categorical: vocab lookup, miss -> OOV slot (n_bins-1)
+//   binned numeric: clip(floor(v / bucket_width) - bin_offset, 0, n_bins-1)
+//   continuous: parsed as float
+//   label: vocab lookup, miss -> error
+//
+// Build: g++ -O3 -shared -fPIC (driven by avenir_tpu/runtime/native.py).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// column kinds, mirroring FeatureField roles
+enum Kind : int32_t {
+  kCategorical = 0,   // binned via vocab
+  kBinnedNumeric = 1, // binned via bucket width
+  kContinuous = 2,    // raw float feature
+  kLabel = 3,         // class attribute via vocab
+  kId = 4,            // record id: emit (offset, length) into the buffer
+};
+
+// error codes (negative returns)
+constexpr long kErrRagged = -1;
+constexpr long kErrBadNumber = -2;
+constexpr long kErrUnknownLabel = -3;
+constexpr long kErrTooManyRows = -4;
+
+struct ColumnSpec {
+  int32_t kind;
+  int32_t ordinal;
+  double bucket_width;
+  int64_t bin_offset;
+  int32_t n_bins;
+  std::unordered_map<std::string, int32_t> vocab;
+};
+
+bool parse_double(const char* s, size_t n, double* out) {
+  if (n == 0) return false;
+  std::string tmp(s, n);
+  char* end = nullptr;
+  *out = std::strtod(tmp.c_str(), &end);
+  return end == tmp.c_str() + tmp.size();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse + encode up to max_rows CSV records from buf[0:len].
+//
+// Specs arrive as parallel arrays of length nspec, ordered so that all
+// categorical/binned specs fill codes_out columns 0..n_binned-1 in order,
+// continuous specs fill cont_out columns 0..n_cont-1 in order, and the
+// label spec (at most one) fills labels_out. vocab_blob packs the
+// vocabularies of vocab-bearing specs in spec order: values separated by
+// '\x1f', columns terminated by '\x1e'.
+//
+// Returns the number of rows encoded, or a negative error code with
+// *err_row set to the offending row index.
+long avenir_csv_encode(
+    const char* buf, long len, char delim, int32_t ncols,
+    const int32_t* kinds, const int32_t* ordinals,
+    const double* bucket_widths, const int64_t* bin_offsets,
+    const int32_t* n_bins, int32_t nspec,
+    const char* vocab_blob,
+    int32_t* codes_out, long n_binned,
+    float* cont_out, long n_cont,
+    int32_t* labels_out,
+    int64_t* id_off_out, int32_t* id_len_out,
+    long max_rows, long* err_row) {
+  // build specs
+  std::vector<ColumnSpec> specs(nspec);
+  const char* vb = vocab_blob;
+  for (int32_t i = 0; i < nspec; ++i) {
+    ColumnSpec& c = specs[i];
+    c.kind = kinds[i];
+    c.ordinal = ordinals[i];
+    c.bucket_width = bucket_widths[i];
+    c.bin_offset = bin_offsets[i];
+    c.n_bins = n_bins[i];
+    if (c.kind == kCategorical || c.kind == kLabel) {
+      int32_t code = 0;
+      std::string cur;
+      while (*vb != '\x1e') {
+        if (*vb == '\x1f') {
+          c.vocab.emplace(cur, code++);
+          cur.clear();
+        } else {
+          cur.push_back(*vb);
+        }
+        ++vb;
+      }
+      ++vb;  // skip column terminator
+    }
+  }
+  // spec index -> output slot
+  std::vector<int32_t> slot(nspec, 0);
+  {
+    int32_t bi = 0, ci = 0;
+    for (int32_t i = 0; i < nspec; ++i) {
+      if (specs[i].kind == kCategorical || specs[i].kind == kBinnedNumeric)
+        slot[i] = bi++;
+      else if (specs[i].kind == kContinuous)
+        slot[i] = ci++;
+    }
+  }
+
+  std::vector<const char*> starts(ncols);
+  std::vector<size_t> lens(ncols);
+  long row = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    // locate line
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    // strip CR
+    const char* trimmed = line_end;
+    if (trimmed > p && trimmed[-1] == '\r') --trimmed;
+    if (trimmed == p) {  // blank line
+      p = nl ? nl + 1 : end;
+      continue;
+    }
+    if (row >= max_rows) {
+      *err_row = row;
+      return kErrTooManyRows;
+    }
+    // split fields
+    int32_t f = 0;
+    const char* fs = p;
+    for (const char* q = p; q <= trimmed; ++q) {
+      if (q == trimmed || *q == delim) {
+        if (f < ncols) {
+          starts[f] = fs;
+          lens[f] = static_cast<size_t>(q - fs);
+        }
+        ++f;
+        fs = q + 1;
+      }
+    }
+    if (f != ncols) {
+      *err_row = row;
+      return kErrRagged;
+    }
+    // encode
+    for (int32_t i = 0; i < nspec; ++i) {
+      const ColumnSpec& c = specs[i];
+      const char* s = starts[c.ordinal];
+      size_t n = lens[c.ordinal];
+      switch (c.kind) {
+        case kCategorical: {
+          auto it = c.vocab.find(std::string(s, n));
+          codes_out[row * n_binned + slot[i]] =
+              it == c.vocab.end() ? c.n_bins - 1 : it->second;
+          break;
+        }
+        case kBinnedNumeric: {
+          double v;
+          if (!parse_double(s, n, &v)) {
+            *err_row = row;
+            return kErrBadNumber;
+          }
+          int64_t b = static_cast<int64_t>(std::floor(v / c.bucket_width)) -
+                      c.bin_offset;
+          if (b < 0) b = 0;
+          if (b >= c.n_bins) b = c.n_bins - 1;
+          codes_out[row * n_binned + slot[i]] = static_cast<int32_t>(b);
+          break;
+        }
+        case kContinuous: {
+          double v;
+          if (!parse_double(s, n, &v)) {
+            *err_row = row;
+            return kErrBadNumber;
+          }
+          cont_out[row * n_cont + slot[i]] = static_cast<float>(v);
+          break;
+        }
+        case kLabel: {
+          auto it = c.vocab.find(std::string(s, n));
+          if (it == c.vocab.end()) {
+            *err_row = row;
+            return kErrUnknownLabel;
+          }
+          if (labels_out) labels_out[row] = it->second;
+          break;
+        }
+        case kId: {
+          if (id_off_out) {
+            id_off_out[row] = static_cast<int64_t>(s - buf);
+            id_len_out[row] = static_cast<int32_t>(n);
+          }
+          break;
+        }
+      }
+    }
+    ++row;
+    p = nl ? nl + 1 : end;
+  }
+  return row;
+}
+
+// Count newline-terminated records (for buffer pre-sizing).
+long avenir_csv_count_rows(const char* buf, long len) {
+  long rows = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* trimmed = line_end;
+    if (trimmed > p && trimmed[-1] == '\r') --trimmed;
+    if (trimmed > p) ++rows;
+    p = nl ? nl + 1 : end;
+  }
+  return rows;
+}
+
+}  // extern "C"

@@ -34,6 +34,13 @@ class Counters:
     def as_dict(self) -> Dict[str, Dict[str, int]]:
         return {g: dict(d) for g, d in self._groups.items()}
 
+    def merge(self, other: "Counters") -> "Counters":
+        """Adopt every counter from ``other`` (overwriting same-named ones)."""
+        for group, vals in other.as_dict().items():
+            for name, value in vals.items():
+                self.set(group, name, value)
+        return self
+
     def __repr__(self) -> str:
         lines = []
         for g in sorted(self._groups):

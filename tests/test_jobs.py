@@ -328,3 +328,35 @@ def test_pipeline_dependency_closure(elearn_env, tmp_path):
     counters = p.run(only=["knnClassifier"])
     assert "bayesianDistr" in counters and "knnClassifier" in counters
     assert len(read_lines(p.path("predictions"))) == 300
+
+
+def test_markov_jobs_ragged_sequences(tmp_path):
+    # variable-length sequence rows — the natural shape of sequence files
+    seq = tmp_path / "seq"
+    seq.mkdir()
+    (seq / "part-00000").write_text(
+        "c1,A,B,A,B,A\nc2,A,B\nc3,B,A,B,A\n")
+    conf = JobConfig({})
+    c = get_job("MarkovStateTransitionModel").run(conf, str(seq),
+                                                  str(tmp_path / "markov"))
+    assert c.get("Records", "Processed") == 3
+    lines = read_lines(str(tmp_path / "markov"))
+    assert lines[0].split(",") == ["A", "B"]     # state list header
+
+    # HMM: tagged obs:state tokens, then Viterbi decode with 2 id fields
+    tagged = tmp_path / "tagged"
+    tagged.mkdir()
+    (tagged / "part-00000").write_text(
+        "c1,x:A,y:B,x:A\nc2,y:B,y:B\nc3,x:A,y:B,x:A,x:A\n")
+    get_job("HiddenMarkovModelBuilder").run(conf, str(tagged),
+                                            str(tmp_path / "hmm"))
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    (obs / "part-00000").write_text("u1,1,x,y,x\nu2,2,y\n")
+    conf2 = JobConfig({"hmm.model.file.path": str(tmp_path / "hmm"),
+                       "skip.field.count": "2"})
+    c2 = get_job("ViterbiStatePredictor").run(conf2, str(obs),
+                                              str(tmp_path / "decoded"))
+    decoded = read_lines(str(tmp_path / "decoded"))
+    assert decoded[0].startswith("u1,1,") and decoded[1].startswith("u2,2,")
+    assert decoded[0].count(",") == 4            # 2 id fields + 3 states

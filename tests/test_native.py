@@ -1,0 +1,138 @@
+"""Native data-plane tests — C++ CSV encoder parity with the Python
+DatasetEncoder, error surfaces, chunked streaming, device feeder."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.encoding import DatasetEncoder
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.datagen.elearn import ELEARN_SCHEMA_JSON, generate_elearn
+from avenir_tpu.datagen.retarget import RETARGET_SCHEMA_JSON, generate_retarget
+from avenir_tpu.runtime import native
+from avenir_tpu.runtime.feeder import DeviceFeeder
+
+
+def _csv_bytes(rows) -> bytes:
+    return ("\n".join(",".join(r) for r in rows) + "\n").encode()
+
+
+@pytest.fixture(scope="module")
+def built():
+    ok = native.is_available()
+    assert ok, f"native build failed: {native.build_error()}"
+    return ok
+
+
+def _fitted(schema_json, rows):
+    enc = DatasetEncoder(FeatureSchema.from_json(schema_json))
+    ds = enc.fit_transform(rows)
+    return enc, ds
+
+
+@pytest.mark.parametrize("schema_json,gen", [
+    (CHURN_SCHEMA_JSON, generate_churn),           # categorical + class
+    (ELEARN_SCHEMA_JSON, generate_elearn),         # continuous + class
+    (RETARGET_SCHEMA_JSON, generate_retarget),     # categorical + binned numeric
+])
+def test_native_parity(built, schema_json, gen):
+    rows = gen(500, seed=13)
+    enc, py_ds = _fitted(schema_json, rows)
+    nat = native.encode_bytes(_csv_bytes(rows), enc, ncols=rows.shape[1])
+    np.testing.assert_array_equal(nat.codes, py_ds.codes)
+    np.testing.assert_allclose(nat.cont, py_ds.cont, rtol=1e-6)
+    np.testing.assert_array_equal(nat.labels, py_ds.labels)
+
+
+def test_native_without_labels(built):
+    rows = generate_churn(100, seed=1)
+    enc, _ = _fitted(CHURN_SCHEMA_JSON, rows)
+    nat = native.encode_bytes(_csv_bytes(rows), enc, ncols=rows.shape[1],
+                              with_labels=False)
+    assert nat.labels is None
+    assert nat.codes.shape == (100, 5)
+
+
+def test_native_oov_categorical(built):
+    rows = generate_churn(10, seed=2)
+    enc, _ = _fitted(CHURN_SCHEMA_JSON, rows)
+    mutated = rows.copy()
+    mutated[0, 1] = "never-seen-level"
+    nat = native.encode_bytes(_csv_bytes(mutated), enc, ncols=rows.shape[1])
+    py = enc.transform(mutated)
+    np.testing.assert_array_equal(nat.codes, py.codes)
+    assert nat.codes[0, 0] == enc.n_bins[1] - 1    # OOV slot
+
+
+def test_native_error_surfaces(built):
+    rows = generate_churn(10, seed=3)
+    enc, _ = _fitted(CHURN_SCHEMA_JSON, rows)
+    with pytest.raises(ValueError, match="ragged"):
+        native.encode_bytes(b"a,b\n", enc, ncols=rows.shape[1])
+    bad = rows.copy()
+    bad[3, 6] = "not-a-class"
+    with pytest.raises(ValueError, match="label.*row 3"):
+        native.encode_bytes(_csv_bytes(bad), enc, ncols=rows.shape[1])
+    bad2 = generate_retarget(5, seed=1).copy()
+    enc2, _ = _fitted(RETARGET_SCHEMA_JSON, bad2)
+    bad2[2, 2] = "xx"
+    with pytest.raises(ValueError, match="numeric.*row 2"):
+        native.encode_bytes(_csv_bytes(bad2), enc2, ncols=4)
+
+
+def test_native_crlf_and_blank_lines(built):
+    rows = generate_churn(20, seed=4)
+    enc, py_ds = _fitted(CHURN_SCHEMA_JSON, rows)
+    messy = ("\r\n".join(",".join(r) for r in rows) + "\r\n\r\n\n").encode()
+    nat = native.encode_bytes(messy, enc, ncols=rows.shape[1])
+    np.testing.assert_array_equal(nat.codes, py_ds.codes)
+
+
+def test_native_chunked_stream_parity(built, tmp_path):
+    rows = generate_churn(1000, seed=5)
+    enc, py_ds = _fitted(CHURN_SCHEMA_JSON, rows)
+    path = tmp_path / "churn.csv"
+    path.write_bytes(_csv_bytes(rows))
+    chunks = list(native.iter_encoded_native(
+        str(path), enc, ncols=rows.shape[1], chunk_bytes=4096))
+    assert len(chunks) > 1                     # actually chunked
+    codes = np.concatenate([c.codes for c in chunks])
+    labels = np.concatenate([c.labels for c in chunks])
+    np.testing.assert_array_equal(codes, py_ds.codes)
+    np.testing.assert_array_equal(labels, py_ds.labels)
+
+
+def test_device_feeder_order_and_error():
+    items = [np.full((4,), i) for i in range(10)]
+    out = list(DeviceFeeder(items, depth=3))
+    assert [int(x[0]) for x in out] == list(range(10))
+
+    def bad_gen():
+        yield np.zeros(2)
+        raise RuntimeError("boom")
+
+    feeder = DeviceFeeder(bad_gen())
+    next(feeder)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(feeder)
+
+
+def test_prefetch_encoded_end_to_end(tmp_path):
+    from avenir_tpu.runtime import prefetch_encoded
+    rows = generate_churn(300, seed=6)
+    enc, py_ds = _fitted(CHURN_SCHEMA_JSON, rows)
+    path = tmp_path / "churn.csv"
+    path.write_bytes(_csv_bytes(rows))
+    chunks = list(prefetch_encoded(str(path), enc, ncols=rows.shape[1],
+                                   chunk_bytes=8192))
+    codes = np.concatenate([np.asarray(c.codes) for c in chunks])
+    np.testing.assert_array_equal(codes, py_ds.codes)
+
+
+def test_native_ids_parity(built):
+    rows = generate_churn(50, seed=9)
+    enc, py_ds = _fitted(CHURN_SCHEMA_JSON, rows)
+    nat = native.encode_bytes(_csv_bytes(rows), enc, ncols=rows.shape[1])
+    assert nat.ids is not None
+    np.testing.assert_array_equal(np.asarray(nat.ids, object),
+                                  np.asarray(py_ds.ids, object))
