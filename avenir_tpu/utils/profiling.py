@@ -1,0 +1,92 @@
+"""Tracing/profiling hooks — the observability layer the reference lacks.
+
+The reference's only observability is the Hadoop job UI plus custom counters
+(SURVEY §5). Here: a :func:`trace` context manager around ``jax.profiler``
+(viewable in TensorBoard/XProf), a :class:`StepTimer` for per-step
+wall-times with percentile summaries (blocking on device results so times
+are real), and ``debug.on``-gated logging matching the reference's per-job
+debug flag.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """Capture an XLA/device trace under ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step timing with device synchronization.
+
+    ::
+
+        timer = StepTimer()
+        with timer.step("fit"):
+            out = step_fn(batch)          # timer blocks on out at exit
+        timer.summary()["fit"]["p50_ms"]
+    """
+
+    def __init__(self):
+        self.samples: Dict[str, List[float]] = {}
+        self._pending = None
+
+    @contextlib.contextmanager
+    def step(self, name: str, result=None):
+        start = time.perf_counter()
+        self._pending = None
+        yield self
+        if self._pending is not None:
+            jax.block_until_ready(self._pending)
+            self._pending = None
+        elif result is not None:
+            jax.block_until_ready(result)
+        self.samples.setdefault(name, []).append(
+            (time.perf_counter() - start) * 1e3)
+
+    def block_on(self, value):
+        """Register the step's device output; synced at step exit."""
+        self._pending = value
+        return value
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, ms in self.samples.items():
+            arr = np.asarray(ms)
+            out[name] = {
+                "count": int(arr.size),
+                "mean_ms": float(arr.mean()),
+                "p50_ms": float(np.percentile(arr, 50)),
+                "p95_ms": float(np.percentile(arr, 95)),
+                "max_ms": float(arr.max()),
+            }
+        return out
+
+
+def get_logger(name: str = "avenir_tpu", debug_on: bool = False) -> logging.Logger:
+    """Per-job logger honoring the reference's ``debug.on`` flag
+    (e.g. CramerCorrelation.java:106-109)."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(handler)
+    logger.setLevel(logging.DEBUG if debug_on else logging.INFO)
+    return logger
