@@ -1,9 +1,12 @@
 """Price-optimization generator — planted-structure port of
 resource/price_opt.py.
 
-Mechanism (price_opt.py:6-27): each product gets 6–12 candidate price points
-on an arithmetic grid and a concave revenue curve — revenue climbs by
-``rev_delta`` per step up to a halfway point, then falls — so exactly one
+Mechanism (price_opt.py:6-27): each product draws ``num_price`` in 6–11
+(``randrange(6, 12)``-style exclusive top, price_opt.py:11) and gets
+``num_price − 1`` (i.e. 5–10) candidate price points on an arithmetic grid —
+mirroring the reference generator's own 1-based loop (price_opt.py:17) —
+with a concave revenue curve: revenue climbs by
+``rev_delta`` per step up to a halfway point, then falls, so exactly one
 price is revenue-optimal. A correct bandit must converge its per-product
 selection to that price (the price_optimize_tutorial round loop).
 """

@@ -11,6 +11,7 @@ from typing import Dict, Type
 
 from avenir_tpu.jobs.base import Job
 from avenir_tpu.jobs.bayesian import BayesianDistribution, BayesianPredictor
+from avenir_tpu.jobs.chombo import Projection, RunningAggregator
 from avenir_tpu.jobs.explore import (
     BaggingSampler,
     CramerCorrelation,
@@ -71,6 +72,10 @@ _PACKAGES: Dict[str, str] = {
     "WordCounter": "text",
 }
 
+# chombo sibling-library jobs the runbooks call between avenir jobs — kept
+# addressable by their org.chombo.mr names (SURVEY.md §2.11)
+_CHOMBO_JOBS = {"RunningAggregator", "Projection"}
+
 JOB_CLASSES = [
     BayesianDistribution, BayesianPredictor,
     MutualInformation, CramerCorrelation, HeterogeneityReductionCorrelation,
@@ -81,6 +86,7 @@ JOB_CLASSES = [
     LogisticRegressionJob, FisherDiscriminant,
     GreedyRandomBandit, AuerDeterministic, SoftMaxBandit, RandomFirstGreedyBandit,
     WordCounter,
+    RunningAggregator, Projection,
 ]
 
 REGISTRY: Dict[str, Type[Job]] = {}
@@ -89,6 +95,8 @@ for _cls in JOB_CLASSES:
     pkg = _PACKAGES.get(_cls.name)
     if pkg:
         REGISTRY[f"org.avenir.{pkg}.{_cls.name}"] = _cls
+    if _cls.name in _CHOMBO_JOBS:
+        REGISTRY[f"org.chombo.mr.{_cls.name}"] = _cls
 
 
 def get_job(name: str) -> Job:

@@ -1,0 +1,128 @@
+"""Subsumed chombo MR jobs — the external sibling-library surface that the
+reference's runbooks invoke directly between avenir jobs (SURVEY.md §2.11).
+
+The reference's pipelines are not closed under avenir's own Tool classes:
+the price-optimization bandit loop calls ``org.chombo.mr.RunningAggregator``
+to fold each round's reward measurements into the running
+(group, item, count, sum, avg) state (resource/price_optimize_tutorial.txt:
+44-78, config keys ``incremental.file.prefix`` / ``quantity.attr`` at :88-90),
+and the email-marketing Markov runbook calls ``org.chombo.mr.Projection`` to
+turn transaction rows into per-customer field sequences
+(resource/tutorial_opt_email_marketing.txt:19-42). The rebuild keeps both
+addressable by their chombo class names so those runbooks translate
+verb-for-verb.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.csv_io import read_csv
+from avenir_tpu.jobs.base import Job, input_files, write_output
+from avenir_tpu.utils.metrics import Counters
+
+
+def _fmt(x: float) -> str:
+    """Compact numeric formatting: ints stay ints, floats keep 6 sig figs."""
+    if x == int(x):
+        return str(int(x))
+    return f"{x:.6g}"
+
+
+class RunningAggregator(Job):
+    """org.chombo.mr.RunningAggregator — merge incremental measurement files
+    into running per-(group, item) aggregates.
+
+    Input dir layout (the tutorial's contract): the current aggregate rows
+    ``group,item,count,sum,avg`` plus incremental files whose basename starts
+    with ``incremental.file.prefix`` (default ``inc``) carrying one new
+    measurement per row at column ``quantity.attr``. Output rows are the
+    updated ``group,item,count,sum,avg`` — which feed the next bandit round
+    with ``count.ordinal=2`` / ``reward.ordinal=4``
+    (resource/price_optimize_tutorial.txt:70-90).
+    """
+
+    name = "RunningAggregator"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        prefix = conf.get("incremental.file.prefix", "inc")
+        qattr = conf.get_int("quantity.attr", 2)
+
+        agg: Dict[Tuple[str, str], List[float]] = {}   # insertion-ordered
+        n_inc = 0
+        for f in input_files(input_path):
+            incremental = os.path.basename(f).startswith(prefix)
+            for r in read_csv(f, delim=delim):
+                cell = agg.setdefault((str(r[0]), str(r[1])), [0.0, 0.0])
+                if incremental:
+                    cell[0] += 1.0
+                    cell[1] += float(r[qattr])
+                    n_inc += 1
+                else:
+                    cell[0] += float(r[2])
+                    cell[1] += float(r[3])
+
+        d = conf.field_delim
+        lines = []
+        for (g, item), (cnt, tot) in agg.items():
+            avg = tot / cnt if cnt > 0 else 0.0
+            lines.append(d.join([g, item, _fmt(cnt), _fmt(tot), _fmt(avg)]))
+        write_output(output_path, lines)
+        counters.set("Aggregate", "Keys", len(agg))
+        counters.set("Aggregate", "IncrementalRows", n_inc)
+
+
+class Projection(Job):
+    """org.chombo.mr.Projection (group-by mode) — group rows by a key field,
+    order within the group, and emit the projected fields flattened:
+    ``key,fA(r1),fB(r1),fA(r2),fB(r2),...``.
+
+    The email-marketing runbook projects (date, amount) per customer ordered
+    by date; its downstream state encoder (resource/xaction_state.rb:8-50)
+    consumes exactly that layout. Config: ``projection.key.field`` (default
+    0), ``projection.field.ordinals`` (comma list; default all non-key
+    columns), ``projection.sort.field`` (optional ordinal; lexicographic, so
+    ISO dates order correctly).
+    """
+
+    name = "Projection"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        delim = conf.field_delim_regex
+        key_ord = conf.get_int("projection.key.field", 0)
+        field_ords = conf.get_int_list("projection.field.ordinals", None)
+        sort_field = conf.get("projection.sort.field")
+        sort_ord = int(sort_field) if sort_field is not None else None
+
+        groups: Dict[str, List[Tuple[str, List[str]]]] = {}   # insertion-ordered
+        n_rows = 0
+        for f in input_files(input_path):
+            rows = read_csv(f, delim=delim)
+            if not rows.size:
+                continue
+            ords = field_ords if field_ords is not None else [
+                i for i in range(rows.shape[1]) if i != key_ord]
+            for r in rows:
+                row = [str(v) for v in r]
+                sort_key = row[sort_ord] if sort_ord is not None else ""
+                groups.setdefault(row[key_ord], []).append(
+                    (sort_key, [row[i] for i in ords]))
+                n_rows += 1
+
+        d = conf.field_delim
+        lines = []
+        for key, grp in groups.items():
+            if sort_ord is not None:
+                grp = sorted(grp, key=lambda kv: kv[0])
+            flat: List[str] = [key]
+            for _, vals in grp:
+                flat.extend(vals)
+            lines.append(d.join(flat))
+        write_output(output_path, lines)
+        counters.set("Projection", "Groups", len(groups))
+        counters.set("Projection", "Rows", n_rows)

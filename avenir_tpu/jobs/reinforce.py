@@ -31,7 +31,9 @@ class _BanditRound(Job):
         job = BanditJob(self._algorithm(conf), seed=conf.get_int("seed", 0),
                         **self._kwargs(conf))
         round_num = conf.get_int("current.round.num", 1)
-        lines = job.select_lines(rows, round_num, delim=conf.field_delim)
+        lines = job.select_lines(rows, round_num, delim=conf.field_delim,
+                                 count_ord=conf.get_int("count.ordinal", 2),
+                                 reward_ord=conf.get_int("reward.ordinal", 3))
         write_output(output_path, lines)
         counters.set("Groups", "Selected", len(lines))
         counters.set("Round", "Number", round_num)

@@ -224,10 +224,17 @@ class GroupState:
     valid: np.ndarray                    # [G, K] bool
 
     @classmethod
-    def from_rows(cls, rows: Iterable[Sequence[str]]) -> "GroupState":
+    def from_rows(cls, rows: Iterable[Sequence[str]], count_ord: int = 2,
+                  reward_ord: int = 3) -> "GroupState":
+        """``count_ord``/``reward_ord`` mirror the reference's
+        ``count.ordinal``/``reward.ordinal`` config — the RunningAggregator
+        loop feeds 5-column ``group,item,count,sum,avg`` rows with
+        count.ordinal=2 / reward.ordinal=4
+        (resource/price_optimize_tutorial.txt:70-90)."""
         by_group: Dict[str, List[Tuple[str, float, float]]] = {}
         for r in rows:
-            by_group.setdefault(str(r[0]), []).append((str(r[1]), float(r[2]), float(r[3])))
+            by_group.setdefault(str(r[0]), []).append(
+                (str(r[1]), float(r[count_ord]), float(r[reward_ord])))
         groups = sorted(by_group)
         k = max(len(v) for v in by_group.values())
         g = len(groups)
@@ -278,6 +285,8 @@ class BanditJob:
         return [(g, state.items[gi][int(arm[gi])]) for gi, g in enumerate(state.groups)]
 
     def select_lines(self, rows: Iterable[Sequence[str]], round_num: int,
-                     delim: str = ",") -> List[str]:
-        state = GroupState.from_rows(rows)
+                     delim: str = ",", count_ord: int = 2,
+                     reward_ord: int = 3) -> List[str]:
+        state = GroupState.from_rows(rows, count_ord=count_ord,
+                                     reward_ord=reward_ord)
         return [f"{g}{delim}{item}" for g, item in self.select(state, round_num)]

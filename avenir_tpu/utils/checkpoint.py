@@ -27,26 +27,46 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 _ARRAY_TAG = "__array__"
+_TUPLE_TAG = "__tuple__"
+_DICT_TAG = "__dict__"
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _escape(key: str) -> str:
+    """Array-namespace path escaping: user dict keys may contain '/' (ids are
+    user-controlled), which must not collide with the path separator."""
+    return key.replace("%", "%25").replace("/", "%2F")
 
 
 def _flatten(tree: Any, prefix: str, arrays: Dict[str, np.ndarray]) -> Any:
     """Replace array leaves with tagged references; collect arrays."""
     if isinstance(tree, dict):
-        return {k: _flatten(v, f"{prefix}/{k}", arrays) for k, v in tree.items()}
+        out = {k: _flatten(v, f"{prefix}/{_escape(str(k))}", arrays)
+               for k, v in tree.items()}
+        # a user dict whose single key equals a marker tag would be
+        # misread on load — wrap it so decoding stays unambiguous
+        if len(out) == 1 and next(iter(out)) in (_ARRAY_TAG, _TUPLE_TAG, _DICT_TAG):
+            return {_DICT_TAG: out}
+        return out
     if isinstance(tree, (list, tuple)):
         out = [_flatten(v, f"{prefix}/{i}", arrays) for i, v in enumerate(tree)]
-        return out if isinstance(tree, list) else {"__tuple__": out}
+        return out if isinstance(tree, list) else {_TUPLE_TAG: out}
+    # numpy scalars also expose .shape/.dtype — convert them first so they
+    # round-trip as Python scalars, not 0-d arrays
+    if isinstance(tree, np.bool_):
+        return bool(tree)
+    if isinstance(tree, np.integer):
+        return int(tree)
+    if isinstance(tree, np.floating):
+        return float(tree)
     if hasattr(tree, "shape") and hasattr(tree, "dtype"):
-        key = prefix.lstrip("/")
+        # "k:" guard: np.savez(file, **kwds) would reject a bare key named
+        # "file" (collides with its positional parameter)
+        key = "k:" + prefix.lstrip("/")
         arrays[key] = np.asarray(tree)
         return {_ARRAY_TAG: key}
     if isinstance(tree, (str, int, float, bool)) or tree is None:
         return tree
-    if isinstance(tree, (np.integer,)):
-        return int(tree)
-    if isinstance(tree, (np.floating,)):
-        return float(tree)
     raise TypeError(f"unsupported checkpoint leaf type {type(tree)!r} at {prefix}")
 
 
@@ -54,8 +74,10 @@ def _unflatten(node: Any, arrays: Dict[str, np.ndarray]) -> Any:
     if isinstance(node, dict):
         if _ARRAY_TAG in node and len(node) == 1:
             return arrays[node[_ARRAY_TAG]]
-        if "__tuple__" in node and len(node) == 1:
-            return tuple(_unflatten(v, arrays) for v in node["__tuple__"])
+        if _TUPLE_TAG in node and len(node) == 1:
+            return tuple(_unflatten(v, arrays) for v in node[_TUPLE_TAG])
+        if _DICT_TAG in node and len(node) == 1:
+            return {k: _unflatten(v, arrays) for k, v in node[_DICT_TAG].items()}
         return {k: _unflatten(v, arrays) for k, v in node.items()}
     if isinstance(node, list):
         return [_unflatten(v, arrays) for v in node]
@@ -74,14 +96,30 @@ def save_state(path: str, state: Any) -> None:
             json.dump(structure, fh)
         np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
         if os.path.exists(path):
-            shutil.rmtree(path)
-        os.replace(tmp, path)
+            # move the old snapshot to a visible <path>.bak before swapping
+            # the new one in: a crash in the window leaves the .bak, which
+            # load_state and CheckpointManager both know how to recover
+            bak = path.rstrip(os.sep) + ".bak"
+            shutil.rmtree(bak, ignore_errors=True)      # stale prior crash
+            os.replace(path, bak)
+            try:
+                os.replace(tmp, path)
+            except BaseException:
+                os.replace(bak, path)                   # roll back
+                raise
+            shutil.rmtree(bak, ignore_errors=True)
+        else:
+            os.replace(tmp, path)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
 
 
 def load_state(path: str) -> Any:
+    if not os.path.exists(os.path.join(path, "state.json")) and \
+            os.path.exists(path.rstrip(os.sep) + ".bak"):
+        # crash during an overwrite swap: the complete old snapshot is at .bak
+        path = path.rstrip(os.sep) + ".bak"
     with open(os.path.join(path, "state.json")) as fh:
         structure = json.load(fh)
     npz_path = os.path.join(path, "arrays.npz")
@@ -103,6 +141,26 @@ class CheckpointManager:
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        self._recover()
+
+    def _recover(self) -> None:
+        """Finish any overwrite swap interrupted by a crash: promote orphaned
+        ``step_N.bak`` snapshots, drop redundant ones, and sweep leftover
+        ``.ckpt_*`` temp dirs (each holds a full-size snapshot copy).
+        Single-writer assumption: no concurrent save may be in flight."""
+        for name in os.listdir(self.directory):
+            if name.startswith(".ckpt_"):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+                continue
+            if not name.endswith(".bak") or not _STEP_RE.match(name[:-4]):
+                continue
+            bak = os.path.join(self.directory, name)
+            live = bak[:-4]
+            if os.path.exists(live):
+                shutil.rmtree(bak, ignore_errors=True)
+            else:
+                os.replace(bak, live)
 
     def _steps(self) -> List[int]:
         out = []

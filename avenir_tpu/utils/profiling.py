@@ -35,11 +35,13 @@ def trace(log_dir: Optional[str]):
 class StepTimer:
     """Wall-clock step timing with device synchronization.
 
-    ::
+    Register the step's device output via :meth:`block_on` — JAX dispatch is
+    async, so without the block the recorded time would measure only dispatch
+    latency, not the step::
 
         timer = StepTimer()
-        with timer.step("fit"):
-            out = step_fn(batch)          # timer blocks on out at exit
+        with timer.step("fit") as t:
+            out = t.block_on(step_fn(batch))   # synced at step exit
         timer.summary()["fit"]["p50_ms"]
     """
 
@@ -48,15 +50,13 @@ class StepTimer:
         self._pending = None
 
     @contextlib.contextmanager
-    def step(self, name: str, result=None):
+    def step(self, name: str):
         start = time.perf_counter()
         self._pending = None
         yield self
         if self._pending is not None:
             jax.block_until_ready(self._pending)
             self._pending = None
-        elif result is not None:
-            jax.block_until_ready(result)
         self.samples.setdefault(name, []).append(
             (time.perf_counter() - start) * 1e3)
 

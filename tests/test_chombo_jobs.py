@@ -1,0 +1,138 @@
+"""Subsumed chombo jobs (RunningAggregator, Projection) + the full
+price-optimization runbook loop driven purely through the file-based jobs
+layer — the tutorial's bandit → measure → RunningAggregator → next-round
+cycle (resource/price_optimize_tutorial.txt:15-90) as an automated test."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.datagen.price_opt import generate_price_opt
+from avenir_tpu.jobs import REGISTRY, get_job
+from avenir_tpu.jobs.base import read_lines
+
+
+def test_chombo_registry_names():
+    assert "org.chombo.mr.RunningAggregator" in REGISTRY
+    assert "org.chombo.mr.Projection" in REGISTRY
+
+
+def test_projection_groups_orders_and_flattens(tmp_path):
+    # transaction rows: custID, xid, date, amount (buy_xaction.rb layout),
+    # deliberately out of date order within a customer
+    rows = [
+        "c1,101,2013-01-05,40",
+        "c2,102,2013-01-02,70",
+        "c1,103,2013-01-02,55",
+        "c1,104,2013-02-11,90",
+        "c2,105,2013-01-20,30",
+    ]
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "xactions.txt").write_text("\n".join(rows) + "\n")
+    conf = JobConfig({
+        "projection.key.field": "0",
+        "projection.field.ordinals": "2,3",
+        "projection.sort.field": "2",
+    })
+    get_job("org.chombo.mr.Projection").run(
+        conf, str(tmp_path / "in"), str(tmp_path / "out"))
+    out = sorted(read_lines(str(tmp_path / "out")))
+    # layout consumed by xaction_state.rb: cust, date1, amt1, date2, amt2, ...
+    assert out == [
+        "c1,2013-01-02,55,2013-01-05,40,2013-02-11,90",
+        "c2,2013-01-02,70,2013-01-20,30",
+    ]
+
+
+def test_running_aggregator_merges_incrementals(tmp_path):
+    indir = tmp_path / "in"
+    indir.mkdir()
+    # current aggregate: group,item,count,sum,avg
+    (indir / "agg.txt").write_text("p1,10,2,200,100\np1,12,1,40,40\n")
+    # incremental measurements: group,item,value (quantity.attr=2)
+    (indir / "inc_round3.txt").write_text("p1,10,100\np1,12,80\np1,10,70\n")
+    conf = JobConfig({"quantity.attr": "2", "incremental.file.prefix": "inc"})
+    c = get_job("RunningAggregator").run(conf, str(indir), str(tmp_path / "out"))
+    out = {ln.split(",")[1]: ln.split(",") for ln in read_lines(str(tmp_path / "out"))}
+    # item 10: count 2+2=4, sum 200+170=370
+    assert out["10"][2:4] == ["4", "370"]
+    assert float(out["10"][4]) == pytest.approx(92.5)
+    # item 12: count 1+1=2, sum 40+80=120, avg 60
+    assert out["12"][2:5] == ["2", "120", "60"]
+    assert c.get("Aggregate", "IncrementalRows") == 3
+
+
+@pytest.mark.parametrize("bandit_job,props,n_rounds,assert_converge", [
+    # UCB1's √(2·ln t/n) bonus (the reference's own normalized formula,
+    # AuerDeterministic.java:212) dwarfs the ~4% adjacent-price revenue gaps
+    # at file-loop-feasible round counts, so only the loop mechanics are
+    # asserted here; UCB1 convergence is covered at the model layer with
+    # larger gaps (test_rl.test_bandit_price_optimization).
+    ("org.avenir.reinforce.AuerDeterministic", {}, 25, False),
+    ("org.avenir.reinforce.GreedyRandomBandit",
+     {"prob.reduction.algorithm": "linear",
+      "random.selection.prob": "0.5",
+      "prob.reduction.constant": "8.0"}, 60, True),
+])
+def test_price_optimize_runbook_loop(tmp_path, bandit_job, props, n_rounds,
+                                     assert_converge):
+    """The tutorial's round loop, file for file: bandit job selects a price
+    per product; the revenue oracle writes an inc file; RunningAggregator
+    folds it into the running state; the state becomes the next round's
+    input. The bandit must converge to the revenue-optimal price."""
+    sim = generate_price_opt(n_products=8, seed=5)
+    indir = tmp_path / "input"
+    indir.mkdir()
+    # bootstrap aggregate: group,item,count,sum,avg — no pulls yet
+    lines = [f"{pid},{price},0,0,0"
+             for pid, p in sim.products.items() for price in p.prices]
+    (indir / "agg.txt").write_text("\n".join(lines) + "\n")
+
+    selections = []
+    for rnd in range(1, n_rounds + 1):
+        conf = JobConfig({
+            "current.round.num": str(rnd),
+            "count.ordinal": "2",
+            "reward.ordinal": "4",
+            "seed": str(100 + rnd),
+            **props,
+        })
+        get_job(bandit_job).run(conf, str(indir), str(tmp_path / "select"))
+        selections = [ln.split(",") for ln in read_lines(str(tmp_path / "select"))]
+        assert len(selections) == len(sim.products)
+        # revenue oracle → incremental measurement file (group,item,profit)
+        inc = [f"{pid},{price},{sim.reward(pid, price):.3f}"
+               for pid, price in selections]
+        (indir / f"inc_{rnd}.txt").write_text("\n".join(inc) + "\n")
+        conf_agg = JobConfig({"quantity.attr": "2",
+                              "incremental.file.prefix": "inc"})
+        get_job("org.chombo.mr.RunningAggregator").run(
+            conf_agg, str(indir), str(tmp_path / "agg_out"))
+        # next round: aggregate output replaces the input dir contents
+        shutil.rmtree(indir)
+        indir.mkdir()
+        shutil.copy(str(tmp_path / "agg_out" / "part-00000"),
+                    str(indir / "agg.txt"))
+
+    # loop mechanics: the running state accumulated exactly one pull per
+    # product per round
+    final = [ln.split(",") for ln in read_lines(str(indir / "agg.txt"))]
+    per_group = {}
+    for g, _item, cnt, _s, _a in final:
+        per_group[g] = per_group.get(g, 0) + int(cnt)
+    assert all(v == n_rounds for v in per_group.values())
+
+    if assert_converge:
+        # final-round selections: most products at (or adjacent to) optimum
+        n_good = 0
+        for pid, price in selections:
+            p = sim.products[pid]
+            picked = p.prices.index(int(price))
+            best = int(np.argmax(p.mean_revenue))
+            if abs(picked - best) <= 1:
+                n_good += 1
+        assert n_good >= int(0.75 * len(sim.products)), \
+            f"only {n_good}/{len(sim.products)} products near-optimal"

@@ -166,3 +166,58 @@ def test_xaction_markov_recovery():
     # rows format for the job layer is (custID, states...)
     rows = sequences_to_rows(seqs)
     assert rows[0][0] == "C0000000" and rows[0][1] in STATES
+
+
+def test_checkpoint_slash_keys_and_reserved_tags(tmp_path):
+    """Keys containing '/' must not collide in the array namespace, and user
+    dicts whose single key matches a marker tag must round-trip verbatim."""
+    state = {
+        "a": {"b": np.zeros(2)},
+        "a/b": np.ones(2),
+        "tagged": {"__array__": "not-a-ref"},
+        "tup": {"__tuple__": "also-plain"},
+    }
+    save_state(str(tmp_path / "s"), state)
+    back = load_state(str(tmp_path / "s"))
+    np.testing.assert_array_equal(back["a"]["b"], np.zeros(2))
+    np.testing.assert_array_equal(back["a/b"], np.ones(2))
+    assert back["tagged"] == {"__array__": "not-a-ref"}
+    assert back["tup"] == {"__tuple__": "also-plain"}
+
+
+def test_checkpoint_numpy_scalars_roundtrip_as_python(tmp_path):
+    save_state(str(tmp_path / "s"), {"round": np.int64(5), "lr": np.float32(0.5),
+                                     "flag": np.bool_(True)})
+    back = load_state(str(tmp_path / "s"))
+    assert back["round"] == 5 and isinstance(back["round"], int)
+    assert back["lr"] == 0.5 and isinstance(back["lr"], float)
+    assert back["flag"] is True
+
+
+def test_checkpoint_crash_window_recovery(tmp_path):
+    """A kill between the two swap renames leaves <dir>.bak; both load_state
+    and CheckpointManager must recover the complete old snapshot."""
+    import os
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, keep=3)
+    mgr.save(1, {"v": np.arange(3.0)})
+    # simulate the crash window: live dir renamed aside, new one never landed
+    os.replace(os.path.join(d, "step_1"), os.path.join(d, "step_1.bak"))
+    back = load_state(os.path.join(d, "step_1"))
+    np.testing.assert_array_equal(back["v"], np.arange(3.0))
+    mgr2 = CheckpointManager(d, keep=3)        # recovery sweep promotes .bak
+    assert mgr2.latest_step() == 1
+    np.testing.assert_array_equal(mgr2.restore()["v"], np.arange(3.0))
+    assert not os.path.exists(os.path.join(d, "step_1.bak"))
+
+
+def test_checkpoint_file_key_and_orphan_sweep(tmp_path):
+    import os
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, keep=3)
+    mgr.save(1, {"file": np.arange(2.0), "args": "x"})   # np.savez param names
+    np.testing.assert_array_equal(mgr.restore()["file"], np.arange(2.0))
+    # orphaned temp dir from a crashed save is swept on manager init
+    os.makedirs(os.path.join(d, ".ckpt_orphan"))
+    CheckpointManager(d, keep=3)
+    assert not os.path.exists(os.path.join(d, ".ckpt_orphan"))
