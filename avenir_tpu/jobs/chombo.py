@@ -96,8 +96,7 @@ class Projection(Job):
         delim = conf.field_delim_regex
         key_ord = conf.get_int("projection.key.field", 0)
         field_ords = conf.get_int_list("projection.field.ordinals", None)
-        sort_field = conf.get("projection.sort.field")
-        sort_ord = int(sort_field) if sort_field is not None else None
+        sort_ord = conf.get_int("projection.sort.field")
 
         groups: Dict[str, List[Tuple[str, List[str]]]] = {}   # insertion-ordered
         n_rows = 0

@@ -18,6 +18,7 @@ recursion. Here:
 
 from __future__ import annotations
 
+import json
 import os
 from typing import List, Optional
 
@@ -26,7 +27,7 @@ import numpy as np
 from avenir_tpu.core.config import JobConfig
 from avenir_tpu.jobs.base import Job, read_input, read_lines, write_output
 from avenir_tpu.models import tree as dtree
-from avenir_tpu.utils.metrics import Counters
+from avenir_tpu.utils.metrics import ConfusionMatrix, Counters
 
 import jax.numpy as jnp
 
@@ -160,14 +161,19 @@ class DataPartitioner(Job):
 class DecisionTreeBuilder(Job):
     """Whole-tree induction in one job (the in-memory frontier loop that
     replaces the per-level SplitGenerator/DataPartitioner alternation).
-    Output: the tree as a one-line JSON model plus, in validation mode,
-    confusion counters."""
+    Output: the tree as a JSON model line plus a fitted-encoder-state line
+    (the tree's ``seg_of_bin`` tables are keyed by raw train-time bin codes,
+    so scoring must reuse the train-time code space, not re-fit on its
+    input); validation mode adds confusion counters."""
 
     name = "DecisionTreeBuilder"
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        _enc, ds, _rows = self.encode_input(conf, input_path)
+        if conf.get("tree.model.file.path"):
+            self._predict(conf, input_path, output_path, counters)
+            return
+        enc, ds, _rows = self.encode_input(conf, input_path)
         schema = self.load_schema(conf)
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]
@@ -181,7 +187,8 @@ class DecisionTreeBuilder(Job):
             seed=conf.get_int("seed", 0),
         )
         model = trainer.fit(ds, is_cat)
-        write_output(output_path, [model.to_string()])
+        write_output(output_path, [model.to_string(),
+                                   json.dumps({"encoder": enc.state_dict()})])
         if conf.get("prediction.mode") == "validation":
             _pred, _distr, cm, c2 = trainer.predict(
                 model, ds, validate=True,
@@ -189,3 +196,49 @@ class DecisionTreeBuilder(Job):
             counters.merge(c2)
         counters.set("Records", "Processed", ds.num_rows)
         counters.set("Tree", "Nodes", len(model.nodes))
+
+    def _predict(self, conf: JobConfig, input_path: str, output_path: str,
+                 counters: Counters) -> None:
+        """Score new rows with a saved JSON tree model
+        (``tree.model.file.path``), appending the predicted class — the same
+        output contract as BayesianPredictor. The model file's second line
+        carries the fitted encoder state, restored here so codes (and label
+        indices, in validation mode) live in the train-time space."""
+        model_lines = read_lines(conf.get("tree.model.file.path"))
+        model = dtree.DecisionTreeModel.from_string(model_lines[0])
+        enc = self.encoder_for(conf)
+        if len(model_lines) > 1:
+            enc.load_state_dict(json.loads(model_lines[1])["encoder"])
+        else:
+            # never re-fit on the scoring input: codes would shift whenever
+            # its value range/vocabulary differs from training
+            missing = [f.name for f in enc.binned_fields
+                       if f.ordinal not in enc.vocab
+                       and f.ordinal not in enc.bin_offset]
+            if missing or not enc.class_values:
+                raise ValueError(
+                    "tree model file has no encoder-state line and the schema "
+                    f"does not fully specify the encoding (missing: {missing}"
+                    f"{'' if enc.class_values else ', class cardinality'}); "
+                    "re-train with this version to embed encoder state")
+            enc._fitted = True
+        validation = conf.get("prediction.mode") == "validation"
+        _enc, ds, rows = self.encode_input(conf, input_path,
+                                           with_labels=validation,
+                                           encoder=enc)
+        if validation and ds.labels is None:
+            raise ValueError("prediction.mode=validation requires labeled "
+                             "input (class column missing)")
+        walk = dtree.predict_fn(model)
+        pred, _distr = walk(jnp.asarray(ds.codes))
+        pred = np.asarray(pred)
+        delim = conf.field_delim
+        lines = [delim.join(list(r) + [model.class_values[int(p)]])
+                 for r, p in zip(rows, pred)]
+        write_output(output_path, lines)
+        if validation:
+            cm = ConfusionMatrix(model.class_values,
+                                 pos_class=conf.get("positive.class.value"))
+            cm.add_batch(ds.labels, pred)
+            cm.publish(counters)
+        counters.set("Records", "Processed", ds.num_rows)

@@ -150,3 +150,115 @@ def test_random_forest(retarget):
     maj = max(np.bincount(ds.labels)) / ds.num_rows
     assert acc >= maj - 0.02
     np.testing.assert_allclose(votes.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_tree_builder_predict_from_saved_model(tmp_path):
+    """DecisionTreeBuilder with tree.model.file.path scores new rows from the
+    saved JSON model (the predictor path the directory-tree reference lacks)."""
+    import json
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.retarget import RETARGET_SCHEMA_JSON, generate_retarget
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    rows = generate_retarget(1500, seed=4)
+    write_csv(str(tmp_path / "train.csv"), rows[:1200])
+    write_csv(str(tmp_path / "test.csv"), rows[1200:])
+    (tmp_path / "retarget.json").write_text(json.dumps(RETARGET_SCHEMA_JSON))
+    conf = JobConfig({"feature.schema.file.path": str(tmp_path / "retarget.json"),
+                      "max.depth": "3"})
+    get_job("DecisionTreeBuilder").run(conf, str(tmp_path / "train.csv"),
+                                       str(tmp_path / "model"))
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("tree.model.file.path", str(tmp_path / "model"))
+    conf2.set("prediction.mode", "validation")
+    c = get_job("DecisionTreeBuilder").run(conf2, str(tmp_path / "test.csv"),
+                                           str(tmp_path / "pred"))
+    out = read_lines(str(tmp_path / "pred"))
+    assert len(out) == 300
+    classes = {ln.rsplit(",", 1)[1] for ln in out}
+    assert classes <= {"N", "Y"}
+    # planted structure (retarget.py conversion table) => decent accuracy
+    assert c.get("Validation", "accuracy") >= 60
+
+
+def test_tree_predict_survives_shifted_scoring_distribution(tmp_path):
+    """The saved model carries the fitted encoder state: a scoring batch with
+    a shifted numeric range and a missing categorical value must produce the
+    same routing as train-time codes (no silent bin misalignment)."""
+    import json
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    # schema with an open-vocab categorical and an unbounded numeric field
+    schema = {"fields": [
+        {"name": "id", "ordinal": 0, "dataType": "string", "id": True},
+        {"name": "color", "ordinal": 1, "dataType": "categorical", "feature": True},
+        {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+         "bucketWidth": 50, "maxSplit": 3},
+        {"name": "label", "ordinal": 3, "dataType": "categorical", "classAttr": True},
+    ]}
+    (tmp_path / "s.json").write_text(json.dumps(schema))
+    rng = np.random.default_rng(0)
+    colors = ["red", "green", "blue"]
+
+    def make_rows(n, lo, hi, color_pool):
+        rows = []
+        for i in range(n):
+            c = color_pool[int(rng.integers(len(color_pool)))]
+            amt = int(rng.integers(lo, hi))
+            # planted rule: blue OR amount >= 300 -> Y
+            y = "Y" if (c == "blue" or amt >= 300) else "N"
+            rows.append([f"r{i}", c, str(amt), y])
+        return rows
+
+    train = make_rows(3000, 20, 500, colors)
+    # scoring set: amounts start at 320 (shifted range) and no "red" at all
+    test = make_rows(300, 320, 500, ["green", "blue"])
+    with open(tmp_path / "train.csv", "w") as fh:
+        fh.write("\n".join(",".join(r) for r in train))
+    with open(tmp_path / "test.csv", "w") as fh:
+        fh.write("\n".join(",".join(r) for r in test))
+
+    conf = JobConfig({"feature.schema.file.path": str(tmp_path / "s.json"),
+                      "max.depth": "3", "min.node.size": "16"})
+    get_job("DecisionTreeBuilder").run(conf, str(tmp_path / "train.csv"),
+                                       str(tmp_path / "model"))
+    conf2 = JobConfig(dict(conf.props))
+    conf2.set("tree.model.file.path", str(tmp_path / "model"))
+    get_job("DecisionTreeBuilder").run(conf2, str(tmp_path / "test.csv"),
+                                       str(tmp_path / "pred"))
+    out = read_lines(str(tmp_path / "pred"))
+    # every scoring row satisfies the planted Y rule (amount >= 320)
+    pred = [ln.rsplit(",", 1)[1] for ln in out]
+    assert pred.count("Y") >= 0.95 * len(pred), \
+        f"bin misalignment: only {pred.count('Y')}/{len(pred)} predicted Y"
+
+
+def test_tree_predict_refuses_model_without_encoder_state(tmp_path):
+    """Legacy single-line model + schema that doesn't pin the encoding must
+    be refused, not silently re-fitted on the scoring input."""
+    import json
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.jobs import get_job
+
+    schema = {"fields": [
+        {"name": "amount", "ordinal": 0, "dataType": "int", "feature": True,
+         "bucketWidth": 50, "maxSplit": 3},
+        {"name": "label", "ordinal": 1, "dataType": "categorical", "classAttr": True},
+    ]}
+    (tmp_path / "s.json").write_text(json.dumps(schema))
+    # single-line (legacy) model file
+    model = dtree.DecisionTreeModel(
+        nodes=[dtree.TreeNode(node_id=0, depth=0,
+                              class_counts=np.array([1.0, 1.0]))],
+        class_values=["N", "Y"], max_bins=4, algorithm="entropy")
+    (tmp_path / "model.txt").write_text(model.to_string() + "\n")
+    (tmp_path / "in.csv").write_text("100,N\n")
+    conf = JobConfig({"feature.schema.file.path": str(tmp_path / "s.json"),
+                      "tree.model.file.path": str(tmp_path / "model.txt")})
+    with pytest.raises(ValueError, match="encoder-state"):
+        get_job("DecisionTreeBuilder").run(conf, str(tmp_path / "in.csv"),
+                                           str(tmp_path / "out"))
