@@ -64,6 +64,23 @@ class KNNModel:
     def num_refs(self) -> int:
         return self.codes.shape[0] if self.codes.size else self.cont.shape[0]
 
+    def device_tiles(self, ref_tile: int):
+        """Reference set as resident device arrays [T, ref_tile, ·], padded to
+        a whole number of tiles (pad rows masked out by index in the scan).
+        Cached per tile size: repeated queries must not re-upload the refs."""
+        cache = self.__dict__.setdefault("_dev_tiles", {})
+        if ref_tile not in cache:
+            n = self.num_refs
+            t = max(-(-n // ref_tile), 1)
+            pad = t * ref_tile - n
+            codes = np.pad(self.codes, ((0, pad), (0, 0)))
+            cont = np.pad(self.cont, ((0, pad), (0, 0)))
+            cache[ref_tile] = (
+                jnp.asarray(codes.reshape(t, ref_tile, -1)),
+                jnp.asarray(cont.reshape(t, ref_tile, -1)),
+            )
+        return cache[ref_tile]
+
 
 def fit_knn(
     ds: EncodedDataset,
@@ -90,8 +107,7 @@ def _normalize_cont(cont, lo, hi):
     return jnp.clip((cont - lo) / span, 0.0, 1.0)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "metric"))
-def tile_distances(
+def _tile_distances(
     test_codes: jax.Array, test_cont: jax.Array,     # [M, F], [M, Fc]
     ref_codes: jax.Array, ref_cont: jax.Array,       # [T, F], [T, Fc]
     cont_lo: jax.Array, cont_hi: jax.Array,
@@ -129,40 +145,62 @@ def tile_distances(
     return jnp.clip(d, 0.0, 1.0)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def topk_merge(best_d, best_i, tile_d, tile_i, k: int):
-    """Merge a new tile of distances into the running k best (smallest)."""
-    d = jnp.concatenate([best_d, tile_d], axis=1)
-    i = jnp.concatenate([best_i, tile_i], axis=1)
-    neg, pos = jax.lax.top_k(-d, k)
-    return -neg, jnp.take_along_axis(i, pos, axis=1)
+@functools.partial(jax.jit, static_argnames=("k", "num_bins", "metric"))
+def _topk_over_tiles(test_codes, test_cont, ref_codes_t, ref_cont_t, n_real,
+                     cont_lo, cont_hi, k: int, num_bins: int, metric: str):
+    """One compiled pass: lax.scan over resident reference tiles
+    ([T, tile, ·]), fusing distance + running top-k merge, so the N×M
+    distance matrix never materializes and no per-tile dispatch/upload
+    happens. Pad rows (index ≥ n_real) are masked to +inf."""
+    m = test_codes.shape[0] if test_codes.size else test_cont.shape[0]
+    tile = ref_codes_t.shape[1] if ref_codes_t.size else ref_cont_t.shape[1]
+
+    def body(carry, xs):
+        best_d, best_i, t0 = carry
+        rc, rx = xs
+        d = _tile_distances(test_codes, test_cont, rc, rx,
+                            cont_lo, cont_hi, num_bins, metric)
+        idx = t0 + jnp.arange(tile, dtype=jnp.int32)
+        d = jnp.where(idx[None, :] < n_real, d, jnp.inf)
+        cd = jnp.concatenate([best_d, d], axis=1)
+        cix = jnp.concatenate([best_i, jnp.broadcast_to(idx[None, :], d.shape)],
+                              axis=1)
+        neg, pos = jax.lax.top_k(-cd, k)
+        return (-neg, jnp.take_along_axis(cix, pos, axis=1),
+                t0 + jnp.int32(tile)), None
+
+    best_d = jnp.full((m, k), jnp.inf, jnp.float32)
+    best_i = jnp.full((m, k), -1, jnp.int32)
+    (best_d, best_i, _), _ = jax.lax.scan(
+        body, (best_d, best_i, jnp.int32(0)), (ref_codes_t, ref_cont_t))
+    return best_d, best_i
 
 
 def nearest_neighbors(
     model: KNNModel, test: EncodedDataset, k: int,
-    metric: str = "euclidean", ref_tile: int = 8192, test_tile: int = 4096,
+    metric: str = "euclidean", ref_tile: int = 65536, test_tile: int = 8192,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """([M, k] distances, [M, k] reference indices), ascending by distance."""
     n = model.num_refs
     nb = int(model.n_bins.max()) if model.n_bins.size else 1
     lo, hi = jnp.asarray(model.cont_lo), jnp.asarray(model.cont_hi)
+    ref_tile = min(ref_tile, max(-(-n // 8), 1024))   # ≤8 scan steps small-N
+    rc_t, rx_t = model.device_tiles(ref_tile)
+    k_eff = min(k, n)
     out_d, out_i = [], []
     for m0 in range(0, test.num_rows, test_tile):
         tc = jnp.asarray(test.codes[m0:m0 + test_tile])
         tx = jnp.asarray(test.cont[m0:m0 + test_tile])
-        m = tc.shape[0] if tc.ndim else tx.shape[0]
-        best_d = jnp.full((m, k), jnp.inf, jnp.float32)
-        best_i = jnp.full((m, k), -1, jnp.int32)
-        for r0 in range(0, n, ref_tile):
-            rc = jnp.asarray(model.codes[r0:r0 + ref_tile])
-            rx = jnp.asarray(model.cont[r0:r0 + ref_tile])
-            d = tile_distances(tc, tx, rc, rx, lo, hi, nb, metric)
-            idx = jnp.arange(r0, r0 + rc.shape[0], dtype=jnp.int32)
-            tile_i = jnp.broadcast_to(idx[None, :], d.shape)
-            best_d, best_i = topk_merge(best_d, best_i, d, tile_i, k)
+        best_d, best_i = _topk_over_tiles(
+            tc, tx, rc_t, rx_t, jnp.int32(n), lo, hi, k_eff, nb, metric)
         out_d.append(np.asarray(best_d))
         out_i.append(np.asarray(best_i))
-    return np.concatenate(out_d), np.concatenate(out_i)
+    d = np.concatenate(out_d); i = np.concatenate(out_i)
+    if k_eff < k:           # degenerate tiny reference sets: keep [M, k] shape
+        pad = k - k_eff
+        d = np.pad(d, ((0, 0), (0, pad)), constant_values=np.inf)
+        i = np.pad(i, ((0, 0), (0, pad)), constant_values=-1)
+    return d, i
 
 
 # ---------------------------------------------------------------------------
@@ -212,8 +250,8 @@ class KNN:
         decision_threshold: Optional[float] = None,
         pos_class: Optional[str] = None,
         cost: Optional[np.ndarray] = None,
-        ref_tile: int = 8192,
-        test_tile: int = 4096,
+        ref_tile: int = 65536,
+        test_tile: int = 8192,
     ):
         if kernel not in KERNELS:
             raise ValueError(f"unknown kernel {kernel!r}; known: {KERNELS}")
