@@ -84,3 +84,72 @@ def sharded_nb_fit_step_2d(mesh: Mesh, num_classes: int, num_bins: int):
         out_specs=(P("model", None, None), P()),
     )
     return jax.jit(wrapped)
+
+
+def sharded_knn_topk(mesh: Mesh, k: int, num_bins: int,
+                     metric: str = "euclidean", data_axis: str = "data"):
+    """Exact global k-NN with the reference set sharded over the mesh.
+
+    The reference outsources its O(M·N) all-pairs distances to a Hadoop job
+    (resource/knn.sh:47-60); the multi-chip spelling here shards the
+    reference rows over ``data`` (queries replicated), computes per-device
+    distances + local top-k on the MXU, then merges with one
+    ``lax.all_gather`` of the [M, k] candidates — k·D values per query cross
+    ICI instead of the N-row distance matrix.
+
+    Returns a jitted fn(test_codes, test_cont, ref_codes, ref_cont, lo, hi,
+    n_real) → ([M, k] distances, [M, k] global reference indices). The
+    reference arrays must be padded to a multiple of the data-axis size;
+    pad rows (global index ≥ n_real) are masked to +inf so they can never
+    win the top-k. Requires k ≤ padded-N/D.
+    """
+    from avenir_tpu.models.knn import _tile_distances
+
+    def step(tc, tx, rc, rx, lo, hi, n_real):
+        d = _tile_distances(tc, tx, rc, rx, lo, hi, num_bins, metric)
+        base = jax.lax.axis_index(data_axis) * rc.shape[0]
+        local_idx = base + jnp.arange(rc.shape[0], dtype=jnp.int32)
+        d = jnp.where(local_idx[None, :] < n_real, d, jnp.inf)
+        neg, pos = jax.lax.top_k(-d, k)                     # local top-k
+        gidx = local_idx[pos]
+        # [M, D·k] candidates on every device, then the final exact top-k
+        dg = jax.lax.all_gather(-neg, data_axis, axis=1, tiled=True)
+        ig = jax.lax.all_gather(gidx, data_axis, axis=1, tiled=True)
+        neg2, pos2 = jax.lax.top_k(-dg, k)
+        return -neg2, jnp.take_along_axis(ig, pos2, axis=1)
+
+    # the outputs are replicated (every device holds the same merged top-k
+    # after the all_gather), but shard_map cannot infer that statically —
+    # disable the replication check (kwarg renamed across jax versions)
+    in_specs = (P(), P(), P(data_axis, None), P(data_axis, None), P(), P(), P())
+    try:
+        wrapped = _shard_map(step, mesh=mesh, in_specs=in_specs,
+                             out_specs=(P(), P()), check_vma=False)
+    except TypeError:  # pragma: no cover
+        wrapped = _shard_map(step, mesh=mesh, in_specs=in_specs,
+                             out_specs=(P(), P()), check_rep=False)
+    return jax.jit(wrapped)
+
+
+def sharded_lr_step(mesh: Mesh, data_axis: str = "data"):
+    """Data-parallel logistic-regression step: per-device partial gradient
+    (the reference's per-mapper Σ x·(y−σ(wᵀx)) accumulation,
+    regress/LogisticRegressionJob.java:169-176) + ``psum`` (its single
+    reducer), then the weight update — replicated weights out.
+
+    Returns a jitted fn(w [D], x [N, D] data-sharded, y [N] data-sharded,
+    n_total, lr, l2) → new w.
+    """
+
+    def step(w, x, y, n_total, lr, l2):
+        p = jax.nn.sigmoid(x @ w)
+        partial_g = x.T @ (y - p)                 # local combiner output
+        grad = jax.lax.psum(partial_g, data_axis) / n_total - l2 * w
+        return w + lr * grad
+
+    wrapped = _shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(data_axis, None), P(data_axis), P(), P(), P()),
+        out_specs=P(),
+    )
+    return jax.jit(wrapped)
