@@ -57,6 +57,18 @@ class ClassPartitionGenerator(Job):
                 counters: Counters) -> None:
         _enc, ds, _rows = self.encode_input(conf, input_path)
         p = _tree_params(conf)
+        if conf.get_bool("at.root"):
+            # phase-1 bootstrap of the reference's two-job tree runbook:
+            # emit only the dataset-level info content
+            # (ClassPartitionGenerator.java:206-209,516-519)
+            from avenir_tpu.ops import info as oinfo
+            counts = jnp.bincount(jnp.asarray(ds.labels),
+                                  length=ds.num_classes).astype(jnp.float32)
+            stat_fn = (oinfo.entropy_from_counts if p["algorithm"] == "entropy"
+                       else oinfo.gini_from_counts)
+            write_output(output_path, [f"{float(stat_fn(counts)):.6f}"])
+            counters.set("Records", "Processed", ds.num_rows)
+            return
         schema = self.load_schema(conf)
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]

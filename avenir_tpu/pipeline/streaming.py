@@ -12,8 +12,9 @@ the reward list ``RedisRewardReader.java:72-86``).
 
 Re-design: the topology collapses into an in-process event loop around the
 learner — the queue abstraction survives (in-proc deques for tests and
-embedding; Redis transports gated on the ``redis`` package for drop-in use
-against the reference's own simulators). Learner state is checkpointable
+embedding; Redis transports over the in-tree stdlib RESP client,
+``pipeline/resp.py``, for drop-in use against the reference's own
+simulators — no external redis package). Learner state is checkpointable
 between events (the reference loses bolt state on restart, SURVEY.md §3.5).
 """
 
@@ -105,55 +106,32 @@ class QueueActionWriter:
             self.queue.push(f"{event_id}{self.delim}{a}")
 
 
-# Redis transports — drop-in against the reference's own simulators; gated on
-# the redis package being present (it is not baked into this image).
-try:  # pragma: no cover - environment dependent
-    import redis as _redis
+# Redis transports — the reference's spout/reader/writer contract
+# (RedisSpout.java rpop events; RedisActionWriter.java lpush actions;
+# RedisRewardReader.java reward-list reads) over the in-tree stdlib RESP
+# client (pipeline/resp.py) — no external redis package needed. Rewards are
+# consumed destructively (rpop drain), matching the serving loop's
+# read-once semantics; the reference's non-destructive lindex walk with a
+# running offset is equivalent for a single reader.
 
-    class RedisEventSource:
-        def __init__(self, host="localhost", port=6379, db=0, queue="eventQueue", delim=","):
-            self._r = _redis.StrictRedis(host=host, port=port, db=db)
-            self.queue = queue
-            self.delim = delim
+def _redis_queue(queue, host, port, db):
+    from avenir_tpu.pipeline.resp import RedisListQueue
+    return RedisListQueue(queue, host=host, port=port, db=db)
 
-        def next_event(self):
-            msg = self._r.rpop(self.queue)
-            if msg is None:
-                return None
-            text = msg.decode() if isinstance(msg, bytes) else msg
-            event_id, _, round_num = text.partition(self.delim)
-            return event_id, int(round_num)
 
-    class RedisRewardReader:
-        def __init__(self, host="localhost", port=6379, db=0, queue="rewardQueue", delim=","):
-            self._r = _redis.StrictRedis(host=host, port=port, db=db)
-            self.queue = queue
-            self.delim = delim
+class RedisEventSource(QueueEventSource):
+    def __init__(self, host="localhost", port=6379, db=0, queue="eventQueue", delim=","):
+        super().__init__(_redis_queue(queue, host, port, db), delim=delim)
 
-        def read_rewards(self):
-            out = []
-            while True:
-                msg = self._r.rpop(self.queue)
-                if msg is None:
-                    break
-                text = msg.decode() if isinstance(msg, bytes) else msg
-                action, _, reward = text.partition(self.delim)
-                out.append((action, float(reward)))
-            return out
 
-    class RedisActionWriter:
-        def __init__(self, host="localhost", port=6379, db=0, queue="actionQueue", delim=","):
-            self._r = _redis.StrictRedis(host=host, port=port, db=db)
-            self.queue = queue
-            self.delim = delim
+class RedisRewardReader(QueueRewardReader):
+    def __init__(self, host="localhost", port=6379, db=0, queue="rewardQueue", delim=","):
+        super().__init__(_redis_queue(queue, host, port, db), delim=delim)
 
-        def write(self, event_id, actions):
-            for a in actions:
-                self._r.lpush(self.queue, f"{event_id}{self.delim}{a}")
 
-    HAVE_REDIS = True
-except ImportError:  # pragma: no cover
-    HAVE_REDIS = False
+class RedisActionWriter(QueueActionWriter):
+    def __init__(self, host="localhost", port=6379, db=0, queue="actionQueue", delim=","):
+        super().__init__(_redis_queue(queue, host, port, db), delim=delim)
 
 
 # ---------------------------------------------------------------------------

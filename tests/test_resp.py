@@ -1,0 +1,200 @@
+"""RESP client + Redis streaming transports, tested against an in-process
+fake Redis (a threaded socket server speaking enough RESP for the list
+commands the reference's spout/reader/writer use). The closed-loop test runs
+the full lead-gen scenario through real sockets — the Storm+Redis topology
+(boost_lead_generation_tutorial.txt) with both hops exercised."""
+
+import socket
+import socketserver
+import threading
+from collections import defaultdict, deque
+
+import numpy as np
+import pytest
+
+from avenir_tpu.pipeline.resp import RedisListQueue, RespClient, RespError
+
+
+class _FakeRedisHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        buf = b""
+        while True:
+            try:
+                chunk = self.request.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while True:
+                cmd, buf2 = self._parse(buf)
+                if cmd is None:
+                    break
+                buf = buf2
+                self.request.sendall(self._execute(cmd))
+
+    def _parse(self, buf):
+        # RESP array of bulk strings; returns (args or None, remaining buf)
+        if not buf.startswith(b"*") or b"\r\n" not in buf:
+            return None, buf
+        head, rest = buf.split(b"\r\n", 1)
+        n = int(head[1:])
+        args = []
+        for _ in range(n):
+            if not rest.startswith(b"$") or b"\r\n" not in rest:
+                return None, buf
+            lh, rest2 = rest.split(b"\r\n", 1)
+            ln = int(lh[1:])
+            if len(rest2) < ln + 2:
+                return None, buf
+            args.append(rest2[:ln].decode())
+            rest = rest2[ln + 2:]
+        return args, rest
+
+    def _execute(self, args):
+        lists = self.server.lists
+        with self.server.lock:
+            cmd = args[0].upper()
+            if cmd == "PING":
+                return b"+PONG\r\n"
+            if cmd == "SELECT":
+                return b"+OK\r\n"
+            if cmd == "LPUSH":
+                lists[args[1]].appendleft(args[2])
+                return b":%d\r\n" % len(lists[args[1]])
+            if cmd == "RPOP" and len(args) == 3:
+                if not getattr(self.server, "rpop_count_ok", True):
+                    return b"-ERR wrong number of arguments for 'rpop' command\r\n"
+                q = lists.get(args[1])
+                if not q:
+                    return b"*-1\r\n"
+                vals = [q.pop() for _ in range(min(int(args[2]), len(q)))]
+                if not q:
+                    del lists[args[1]]
+                body = b"".join(b"$%d\r\n%s\r\n" % (len(v.encode()), v.encode())
+                                for v in vals)
+                return b"*%d\r\n%s" % (len(vals), body)
+            if cmd == "RPOP":
+                q = lists.get(args[1])
+                if not q:
+                    return b"$-1\r\n"
+                v = q.pop().encode()
+                if not q:                   # redis removes empty lists
+                    del lists[args[1]]
+                return b"$%d\r\n%s\r\n" % (len(v), v)
+            if cmd == "LLEN":
+                return b":%d\r\n" % len(lists.get(args[1], ()))
+            if cmd == "LINDEX":
+                q = lists.get(args[1])
+                i = int(args[2])
+                if q is None or not (-len(q) <= i < len(q)):
+                    return b"$-1\r\n"
+                v = list(q)[i].encode()
+                return b"$%d\r\n%s\r\n" % (len(v), v)
+            if cmd == "DEL":
+                existed = args[1] in lists
+                lists.pop(args[1], None)
+                return b":%d\r\n" % int(existed)
+            return b"-ERR unknown command '%s'\r\n" % cmd.encode()
+
+
+@pytest.fixture()
+def fake_redis_server():
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _FakeRedisHandler)
+    srv.daemon_threads = True
+    srv.lists = defaultdict(deque)
+    srv.lock = threading.Lock()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture()
+def fake_redis(fake_redis_server):
+    return fake_redis_server.server_address
+
+
+def test_resp_client_basics(fake_redis):
+    host, port = fake_redis
+    c = RespClient(host, port)
+    assert c.ping()
+    assert c.lpush("q", "a") == 1
+    assert c.lpush("q", "b") == 2
+    assert c.llen("q") == 2
+    assert c.lindex("q", 0) == "b"      # lpush prepends
+    assert c.lindex("q", -1) == "a"
+    assert c.rpop("q") == "a"           # FIFO via lpush+rpop
+    assert c.rpop("q") == "b"
+    assert c.rpop("q") is None
+    assert c.delete("q") == 0
+    with pytest.raises(RespError):
+        c.command("BOGUS")
+    c.close()
+
+
+def test_redis_list_queue(fake_redis):
+    host, port = fake_redis
+    q = RedisListQueue("events", host=host, port=port)
+    q.push("e1,1"); q.push("e2,2")
+    assert len(q) == 2
+    assert q.pop() == "e1,1"
+    assert q.drain() == ["e2,2"]
+    assert q.pop() is None
+    # batched drain returns oldest-first, same as single pops
+    for i in range(300):
+        q.push(f"m{i}")
+    assert q.drain() == [f"m{i}" for i in range(300)]
+
+
+def test_redis_list_queue_drain_fallback(fake_redis, request):
+    """Servers without RPOP count (redis < 6.2) must fall back to single
+    pops transparently."""
+    host, port = fake_redis
+    srv = request.getfixturevalue("fake_redis_server")
+    srv.rpop_count_ok = False
+    try:
+        q = RedisListQueue("events", host=host, port=port)
+        q.push("a"); q.push("b"); q.push("c")
+        assert q.drain() == ["a", "b", "c"]
+        assert not q._batch_pop
+        q.push("d")
+        assert q.drain() == ["d"]        # stays on the fallback path
+    finally:
+        srv.rpop_count_ok = True
+
+
+def test_lead_gen_closed_loop_over_redis(fake_redis):
+    """The reference topology, both network hops included: events/rewards
+    pushed through the fake Redis, actions popped from it; the learner must
+    converge to the best page."""
+    from avenir_tpu.models import online_rl as orl
+    from avenir_tpu.pipeline import streaming as st
+
+    host, port = fake_redis
+    ctr = {"page1": (30, 12), "page2": (60, 30), "page3": (80, 10)}
+    rng = np.random.default_rng(7)
+    learner = orl.create_learner(
+        "intervalEstimator", list(ctr), {"min.reward.distr.sample": 15}, seed=3)
+    server = st.ReinforcementLearnerServer(
+        learner,
+        st.RedisEventSource(host, port, "eventQueue"),
+        st.RedisRewardReader(host, port, "rewardQueue"),
+        st.RedisActionWriter(host, port, "actionQueue"))
+    sim_events = RedisListQueue("eventQueue", host=host, port=port)
+    sim_actions = RedisListQueue("actionQueue", host=host, port=port)
+    sim_rewards = RedisListQueue("rewardQueue", host=host, port=port)
+
+    picks = {p: 0 for p in ctr}
+    total = 600
+    for round_num in range(1, total + 1):
+        sim_events.push(f"ev{round_num},{round_num}")
+        assert server.process_one()
+        _, page = sim_actions.pop().split(",")
+        mu, sd = ctr[page]
+        sim_rewards.push(f"{page},{max(rng.normal(mu, sd), 0.0)}")
+        if round_num > total // 2:
+            picks[page] += 1
+    assert max(picks, key=picks.get) == "page3", picks
+    assert server.processed == total

@@ -262,3 +262,29 @@ def test_tree_predict_refuses_model_without_encoder_state(tmp_path):
     with pytest.raises(ValueError, match="encoder-state"):
         get_job("DecisionTreeBuilder").run(conf, str(tmp_path / "in.csv"),
                                            str(tmp_path / "out"))
+
+
+def test_class_partition_generator_at_root(tmp_path):
+    """at.root=true emits only the dataset-level info content (the two-phase
+    root bootstrap of the reference's tree runbook)."""
+    import json
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    rows = generate_retarget(2000, seed=6)
+    write_csv(str(tmp_path / "d.csv"), rows)
+    (tmp_path / "s.json").write_text(json.dumps(RETARGET_SCHEMA_JSON))
+    conf = JobConfig({"feature.schema.file.path": str(tmp_path / "s.json"),
+                      "at.root": "true", "split.algorithm": "entropy"})
+    get_job("ClassPartitionGenerator").run(conf, str(tmp_path / "d.csv"),
+                                           str(tmp_path / "root"))
+    out = read_lines(str(tmp_path / "root"))
+    assert len(out) == 1
+    stat = float(out[0])
+    # binary entropy of the class distribution, in (0, ln 2]
+    labels = np.array([r[-1] for r in rows])
+    p = np.mean(labels == "Y")
+    expected = -(p * np.log(p) + (1 - p) * np.log(1 - p))
+    np.testing.assert_allclose(stat, expected, rtol=1e-4)
