@@ -23,11 +23,75 @@ class BayesianDistribution(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
+        if not conf.get_bool("tabular.input", True):
+            self._execute_text(conf, input_path, output_path, counters)
+            return
         enc, ds, _rows = self.encode_input(conf, input_path)
         model = nb.NaiveBayes(laplace=conf.get_float("laplace.smoothing", 1.0)).fit(ds)
         lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
         write_output(output_path, lines)
         counters.set("Records", "Processed", ds.num_rows)
+
+    def _execute_text(self, conf: JobConfig, input_path: str, output_path: str,
+                      counters: Counters) -> None:
+        """``tabular.input=false``: rows are ``text<delim>classVal``; each
+        analyzer token becomes a bag-of-words feature under ordinal 1 —
+        multinomial NB counts in the same model-row layout
+        (BayesianDistribution.java:125-131,185-196; tokenization flags shared
+        with WordCounter)."""
+        from avenir_tpu.jobs.base import input_files
+        from avenir_tpu.text.analyzer import tokenize
+
+        delim = conf.field_delim_regex
+        stop = conf.get_bool("remove.stop.words", True)
+        stem = conf.get_bool("stem.words", False)
+        vocab: dict = {}
+        token_codes: List[int] = []
+        token_class: List[int] = []
+        class_values: List[str] = []
+        cmap: dict = {}
+        doc_counts: List[int] = []
+        n_rows = 0
+        for f in input_files(input_path):
+            with open(f) as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    if not line.strip():
+                        continue
+                    items = line.split(delim)
+                    text, cv = items[0], items[1]
+                    if cv not in cmap:
+                        cmap[cv] = len(class_values)
+                        class_values.append(cv)
+                        doc_counts.append(0)
+                    ci = cmap[cv]
+                    doc_counts[ci] += 1
+                    n_rows += 1
+                    for tok in tokenize(text, stopwords=stop, stem=stem):
+                        token_codes.append(vocab.setdefault(tok, len(vocab)))
+                        token_class.append(ci)
+        # the count 'shuffle' on device: [C, V] class×token co-occurrence
+        from avenir_tpu.ops import agg
+        if token_codes:
+            cv_counts = np.asarray(agg.transition_counts(
+                np.asarray(token_class, np.int32), np.asarray(token_codes, np.int32),
+                len(class_values), len(vocab)))
+        else:
+            cv_counts = np.zeros((max(len(class_values), 1), 0), np.int64)
+        d = conf.field_delim
+        lines: List[str] = []
+        tokens = list(vocab)
+        for ti, tok in enumerate(tokens):
+            col = cv_counts[:, ti]
+            for ci, cval in enumerate(class_values):
+                if col[ci]:
+                    lines.append(d.join([cval, "1", tok, str(int(col[ci]))]))
+            lines.append(d.join(["", "1", tok, str(int(col.sum()))]))
+        for ci, cval in enumerate(class_values):
+            lines.append(d.join([cval, "", "", str(doc_counts[ci])]))
+        write_output(output_path, lines)
+        counters.set("Records", "Processed", n_rows)
+        counters.set("Model", "Vocabulary", len(vocab))
         counters.set("Model", "Rows", len(lines))
 
 
@@ -74,6 +138,9 @@ class BayesianPredictor(Job):
         model_path = conf.get("bayesian.model.file.path")
         if not model_path:
             raise ValueError("bayesian.model.file.path not set")
+        if not conf.get_bool("tabular.input", True):
+            self._predict_text(conf, input_path, output_path, counters)
+            return
         validate = conf.get("prediction.mode", "prediction") == "validation"
         enc, ds, rows = self.encode_input(conf, input_path, with_labels=validate)
         model = nb.model_from_lines(read_lines(model_path), enc, delim=delim)
@@ -106,3 +173,71 @@ class BayesianPredictor(Job):
         counters.set("Records", "Processed", ds.num_rows)
         if result.counters is not None:
             counters.merge(result.counters)
+
+    def _predict_text(self, conf: JobConfig, input_path: str, output_path: str,
+                      counters: Counters) -> None:
+        """``tabular.input=false``: multinomial-NB scoring of ``text[,class]``
+        rows against a text-mode model (the reference trains text
+        distributions but ships no text predictor — this completes the
+        pipeline; validation uses the second column as the actual class)."""
+        import math
+
+        from avenir_tpu.jobs.base import input_files
+        from avenir_tpu.text.analyzer import tokenize
+        from avenir_tpu.utils.metrics import ConfusionMatrix
+
+        delim = conf.field_delim_regex
+        stop = conf.get_bool("remove.stop.words", True)
+        stem = conf.get_bool("stem.words", False)
+        laplace = conf.get_float("laplace.smoothing", 1.0)
+        validate = conf.get("prediction.mode", "prediction") == "validation"
+
+        # model rows: (classVal, 1, token, count) posteriors; (classVal,,,n) priors
+        token_counts: dict = {}
+        class_counts: dict = {}
+        for line in read_lines(conf.get("bayesian.model.file.path")):
+            items = line.split(delim)
+            if len(items) >= 4 and items[0] and items[1] == "1":
+                token_counts.setdefault(items[0], {})[items[2]] = float(items[3])
+            elif len(items) >= 4 and items[0] and not items[1] and not items[2]:
+                class_counts[items[0]] = float(items[3])
+        class_values = sorted(class_counts)
+        if not class_values:
+            raise ValueError("text model has no class-prior rows")
+        vocab_size = len({t for d in token_counts.values() for t in d})
+        total_docs = sum(class_counts.values())
+        class_token_totals = {cv: sum(token_counts.get(cv, {}).values())
+                              for cv in class_values}
+
+        d = conf.field_delim
+        out: List[str] = []
+        cm = ConfusionMatrix(class_values,
+                             pos_class=conf.get("positive.class.value")) \
+            if validate else None
+        n_rows = 0
+        for f in input_files(input_path):
+            with open(f) as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    if not line.strip():
+                        continue
+                    items = line.split(delim)
+                    toks = tokenize(items[0], stopwords=stop, stem=stem)
+                    best, best_score = None, -math.inf
+                    for cv in class_values:
+                        score = math.log(class_counts[cv] / total_docs)
+                        denom = class_token_totals[cv] + laplace * max(vocab_size, 1)
+                        tc = token_counts.get(cv, {})
+                        for t in toks:
+                            score += math.log((tc.get(t, 0.0) + laplace) / denom)
+                        if score > best_score:
+                            best, best_score = cv, score
+                    out.append(d.join(items + [best]))
+                    n_rows += 1
+                    if cm is not None and len(items) > 1:
+                        cm.add(class_values.index(items[1]),
+                               class_values.index(best))
+        write_output(output_path, out)
+        counters.set("Records", "Processed", n_rows)
+        if cm is not None:
+            cm.publish(counters)
