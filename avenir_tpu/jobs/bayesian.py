@@ -31,6 +31,7 @@ class BayesianDistribution(Job):
         lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
         write_output(output_path, lines)
         counters.set("Records", "Processed", ds.num_rows)
+        counters.set("Model", "Rows", len(lines))
 
     def _execute_text(self, conf: JobConfig, input_path: str, output_path: str,
                       counters: Counters) -> None:
@@ -70,14 +71,17 @@ class BayesianDistribution(Job):
                     for tok in tokenize(text, stopwords=stop, stem=stem):
                         token_codes.append(vocab.setdefault(tok, len(vocab)))
                         token_class.append(ci)
-        # the count 'shuffle' on device: [C, V] class×token co-occurrence
-        from avenir_tpu.ops import agg
+        # [C, V] class×token co-occurrence: a flat bincount — the one-hot
+        # einsum form would materialize an O(tokens × vocab) operand and
+        # agg's chunk guard caps it at 2^24 tokens; counting scales to any
+        # corpus
+        c, v = len(class_values), len(vocab)
         if token_codes:
-            cv_counts = np.asarray(agg.transition_counts(
-                np.asarray(token_class, np.int32), np.asarray(token_codes, np.int32),
-                len(class_values), len(vocab)))
+            flat = (np.asarray(token_class, np.int64) * v
+                    + np.asarray(token_codes, np.int64))
+            cv_counts = np.bincount(flat, minlength=c * v).reshape(c, v)
         else:
-            cv_counts = np.zeros((max(len(class_values), 1), 0), np.int64)
+            cv_counts = np.zeros((max(c, 1), 0), np.int64)
         d = conf.field_delim
         lines: List[str] = []
         tokens = list(vocab)
@@ -215,6 +219,7 @@ class BayesianPredictor(Job):
                              pos_class=conf.get("positive.class.value")) \
             if validate else None
         n_rows = 0
+        unknown_actual = 0
         for f in input_files(input_path):
             with open(f) as fh:
                 for line in fh:
@@ -235,9 +240,16 @@ class BayesianPredictor(Job):
                     out.append(d.join(items + [best]))
                     n_rows += 1
                     if cm is not None and len(items) > 1:
-                        cm.add(class_values.index(items[1]),
-                               class_values.index(best))
+                        if items[1] in class_values:
+                            cm.add(class_values.index(items[1]),
+                                   class_values.index(best))
+                        else:
+                            # actual class absent from the model: count it
+                            # instead of aborting the whole run mid-stream
+                            unknown_actual += 1
         write_output(output_path, out)
         counters.set("Records", "Processed", n_rows)
         if cm is not None:
             cm.publish(counters)
+            if unknown_actual:
+                counters.set("Validation", "UnknownActualClass", unknown_actual)

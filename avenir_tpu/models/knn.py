@@ -45,6 +45,10 @@ from avenir_tpu.utils.metrics import ConfusionMatrix, CostBasedArbitrator, Count
 
 KERNELS = ("none", "linearMultiplicative", "linearAdditive", "gaussian")
 
+# The fused Pallas TPU kernel (ops/pallas_knn.py) is used automatically on
+# TPU backends for the euclidean metric; set to False to force the XLA scan.
+USE_PALLAS = True
+
 
 @dataclass
 class KNNModel:
@@ -63,6 +67,24 @@ class KNNModel:
     @property
     def num_refs(self) -> int:
         return self.codes.shape[0] if self.codes.size else self.cont.shape[0]
+
+    def cont01(self) -> np.ndarray:
+        """Train-range-normalized continuous columns (cached)."""
+        c = self.__dict__.get("_cont01")
+        if c is None:
+            c = self.__dict__["_cont01"] = _normalize01(
+                self.cont, self.cont_lo, self.cont_hi)
+        return c
+
+    def device_packed(self, num_bins: int):
+        """Packed bf16 operand for the fused pallas kernel (cached: repeated
+        queries must not re-pack or re-upload the reference set)."""
+        from avenir_tpu.ops import pallas_knn
+        cache = self.__dict__.setdefault("_dev_packed", {})
+        if num_bins not in cache:
+            cache[num_bins] = pallas_knn.prepare_refs(
+                self.codes, self.cont01(), num_bins)
+        return cache[num_bins]
 
     def device_tiles(self, ref_tile: int):
         """Reference set as resident device arrays [T, ref_tile, ·], padded to
@@ -105,6 +127,11 @@ def fit_knn(
 def _normalize_cont(cont, lo, hi):
     span = jnp.maximum(hi - lo, 1e-9)
     return jnp.clip((cont - lo) / span, 0.0, 1.0)
+
+
+def _normalize01(cont: np.ndarray, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    span = np.maximum(hi - lo, 1e-9)
+    return np.clip((cont - lo) / span, 0.0, 1.0).astype(np.float32)
 
 
 def _tile_distances(
@@ -176,11 +203,68 @@ def _topk_over_tiles(test_codes, test_cont, ref_codes_t, ref_cont_t, n_real,
     return best_d, best_i
 
 
+def _pallas_available(metric: str, k: int) -> bool:
+    if not USE_PALLAS or metric != "euclidean":
+        return False
+    from avenir_tpu.ops import pallas_knn
+    if k + 1 > pallas_knn.SLOTS:
+        return False
+    try:
+        # the Mosaic kernel lowers on TPU only — never dispatch it on gpu
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _nearest_neighbors_pallas(model: KNNModel, test: EncodedDataset, k: int
+                              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Fused-kernel path: exact results via candidate generation + exact f32
+    re-rank + per-row exactness certificate (ops/pallas_knn.py)."""
+    from avenir_tpu.ops import pallas_knn
+    nb = int(model.n_bins.max()) if model.n_bins.size else 1
+    r_mat, n = model.device_packed(nb)
+    cont01_q = _normalize01(test.cont, model.cont_lo, model.cont_hi)
+    q_mat, m = pallas_knn.prepare_queries(test.codes, cont01_q, nb)
+    cand_d2, cand_idx = pallas_knn.topk_candidates(q_mat, r_mat, k)
+    d, idx, cert = pallas_knn.exact_rerank(
+        cand_idx[:m], cand_d2[:m], test.codes, cont01_q,
+        model.codes, model.cont01(), k,
+        test.codes.shape[1] + test.cont.shape[1], n_real=n)
+    if not cert.all():
+        # certificate failed for some rows (approx candidate set might miss a
+        # true neighbor): recompute those rows with the exact XLA scan
+        rows = np.flatnonzero(~cert)
+        sub = EncodedDataset(
+            codes=test.codes[rows], cont=test.cont[rows],
+            labels=None if test.labels is None else test.labels[rows],
+            ids=None, n_bins=test.n_bins, class_values=test.class_values,
+            binned_ordinals=test.binned_ordinals,
+            cont_ordinals=test.cont_ordinals)
+        d_sub, i_sub = _nearest_neighbors_xla(model, sub, k, "euclidean",
+                                              65536, 8192)
+        d[rows] = d_sub
+        idx[rows] = i_sub
+    return d, idx
+
+
 def nearest_neighbors(
     model: KNNModel, test: EncodedDataset, k: int,
     metric: str = "euclidean", ref_tile: int = 65536, test_tile: int = 8192,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """([M, k] distances, [M, k] reference indices), ascending by distance."""
+    """([M, k] distances, [M, k] reference indices), ascending by distance.
+
+    On TPU backends the euclidean metric dispatches to the fused Pallas
+    kernel (exact, ~2× the XLA scan at 1M refs — BASELINE.md); everything
+    else uses the compiled XLA tile scan."""
+    if _pallas_available(metric, k) and min(k, model.num_refs) == k:
+        return _nearest_neighbors_pallas(model, test, k)
+    return _nearest_neighbors_xla(model, test, k, metric, ref_tile, test_tile)
+
+
+def _nearest_neighbors_xla(
+    model: KNNModel, test: EncodedDataset, k: int,
+    metric: str = "euclidean", ref_tile: int = 65536, test_tile: int = 8192,
+) -> Tuple[np.ndarray, np.ndarray]:
     n = model.num_refs
     nb = int(model.n_bins.max()) if model.n_bins.size else 1
     lo, hi = jnp.asarray(model.cont_lo), jnp.asarray(model.cont_hi)

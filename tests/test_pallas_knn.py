@@ -1,0 +1,80 @@
+"""Fused pallas kNN kernel (ops/pallas_knn.py) vs a numpy oracle.
+
+Runs in Mosaic interpret mode on the CPU test mesh; the same code path is
+exercised compiled on real TPU by benchmarks/knn_qps.py."""
+
+import numpy as np
+import pytest
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops import pallas_knn as pk
+
+
+def _oracle(codes_q, cont_q, codes_r, cont_r, k):
+    mism = (codes_q[:, None, :] != codes_r[None, :, :]).sum(-1).astype(np.float64)
+    sq = ((cont_q[:, None, :] - cont_r[None, :, :]) ** 2).sum(-1)
+    d2 = mism + sq
+    idx = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    f = codes_q.shape[1] + cont_q.shape[1]
+    d = np.sqrt(np.take_along_axis(d2, idx, axis=1) / f)
+    return d, idx
+
+
+@pytest.mark.parametrize("f,fc", [(6, 8), (4, 0), (0, 5)])
+def test_pallas_topk_exact(rng, f, fc):
+    nb, k = 7, 5
+    n, m = 3000, 40
+    codes_r = rng.integers(0, nb, size=(n, f)).astype(np.int32)
+    cont_r = rng.random(size=(n, fc)).astype(np.float32)
+    codes_q = rng.integers(0, nb, size=(m, f)).astype(np.int32)
+    cont_q = rng.random(size=(m, fc)).astype(np.float32)
+
+    with pltpu.force_tpu_interpret_mode():
+        r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
+        q_mat, m_real = pk.prepare_queries(codes_q, cont_q, nb)
+        d2, idx = pk.topk_candidates(q_mat, r_mat, k)
+    d, i, cert = pk.exact_rerank(idx[:m_real], d2[:m_real], codes_q, cont_q,
+                                 codes_r, cont_r, k, f + fc)
+    od, oi = _oracle(codes_q, cont_q, codes_r, cont_r, k)
+    assert cert.all()
+    np.testing.assert_allclose(d, od, atol=2e-5)
+    if fc:  # continuous features break distance ties; indices are unique
+        assert (i == oi).mean() == 1.0
+    else:   # pure categorical: integer distances tie heavily — compare values
+        np.testing.assert_allclose(d, od, atol=1e-6)
+
+
+def test_tiny_reference_set_pads_masked(rng):
+    # k <= n < k+MARGIN: pad rows land in candidate slots; their indices
+    # must be masked, not index codes_r out of bounds, and the certificate
+    # must still hold (a pad in the slots proves every real ref was seen)
+    f, fc, nb, k = 3, 2, 5, 10
+    n, m = 12, 8
+    codes_r = rng.integers(0, nb, size=(n, f)).astype(np.int32)
+    cont_r = rng.random(size=(n, fc)).astype(np.float32)
+    codes_q = rng.integers(0, nb, size=(m, f)).astype(np.int32)
+    cont_q = rng.random(size=(m, fc)).astype(np.float32)
+    with pltpu.force_tpu_interpret_mode():
+        r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
+        q_mat, m_real = pk.prepare_queries(codes_q, cont_q, nb)
+        d2, idx = pk.topk_candidates(q_mat, r_mat, k)
+    d, i, cert = pk.exact_rerank(idx[:m_real], d2[:m_real], codes_q, cont_q,
+                                 codes_r, cont_r, k, f + fc, n_real=n)
+    assert cert.all()
+    od, oi = _oracle(codes_q, cont_q, codes_r, cont_r, k)
+    np.testing.assert_allclose(d, od, atol=2e-5)
+    assert (i == oi).all()
+
+
+def test_certificate_flags_close_calls():
+    # rows where the k-th and (k'+1)-th distances collide within the error
+    # bound must not be certified exact
+    cand_idx = np.array([[0, 1, 2]])
+    cand_d2 = np.array([[0.1, 0.2, 0.2 + 1e-6]])   # k'-th ≈ k-th: ambiguous
+    codes_q = np.zeros((1, 0), np.int32)
+    cont_q = np.array([[0.0]], np.float32)
+    codes_r = np.zeros((3, 0), np.int32)
+    cont_r = np.array([[0.32], [0.45], [0.45]], np.float32)
+    d, i, cert = pk.exact_rerank(cand_idx, cand_d2, codes_q, cont_q,
+                                 codes_r, cont_r, k=2, total_attrs=1)
+    assert not cert[0]
