@@ -11,7 +11,7 @@ from typing import Dict, Type
 
 from avenir_tpu.jobs.base import Job
 from avenir_tpu.jobs.bayesian import BayesianDistribution, BayesianPredictor
-from avenir_tpu.jobs.chombo import Projection, RunningAggregator
+from avenir_tpu.jobs.chombo import NumericalAttrStats, Projection, RunningAggregator
 from avenir_tpu.jobs.explore import (
     BaggingSampler,
     CramerCorrelation,
@@ -74,7 +74,7 @@ _PACKAGES: Dict[str, str] = {
 
 # chombo sibling-library jobs the runbooks call between avenir jobs — kept
 # addressable by their org.chombo.mr names (SURVEY.md §2.11)
-_CHOMBO_JOBS = {"RunningAggregator", "Projection"}
+_CHOMBO_JOBS = {"RunningAggregator", "Projection", "NumericalAttrStats"}
 
 JOB_CLASSES = [
     BayesianDistribution, BayesianPredictor,
@@ -86,7 +86,7 @@ JOB_CLASSES = [
     LogisticRegressionJob, FisherDiscriminant,
     GreedyRandomBandit, AuerDeterministic, SoftMaxBandit, RandomFirstGreedyBandit,
     WordCounter,
-    RunningAggregator, Projection,
+    RunningAggregator, Projection, NumericalAttrStats,
 ]
 
 REGISTRY: Dict[str, Type[Job]] = {}

@@ -125,3 +125,75 @@ class Projection(Job):
         write_output(output_path, lines)
         counters.set("Projection", "Groups", len(groups))
         counters.set("Projection", "Rows", n_rows)
+
+
+class NumericalAttrStats(Job):
+    """org.chombo.mr.NumericalAttrStats — per-(attr [, conditioning value])
+    count / sum / sumSq / mean / variance / stdDev / min / max over numeric
+    columns.
+
+    The reference reuses this chombo job's mapper+combiner as the first
+    stage of FisherDiscriminant (discriminant/FisherDiscriminant.java:56-58)
+    and runbooks call it standalone for data profiling. Numeric attrs come
+    from ``attr.list`` or default to every numeric schema feature; an
+    optional ``cond.attr.ord`` (the class ordinal in the Fisher usage)
+    partitions the stats. Moment accumulation runs on device via
+    ops/agg.class_moments — exactly the per-class (count, Σx, Σx²) shuffle
+    the reference's combiner performs map-side.
+    """
+
+    name = "NumericalAttrStats"
+
+    def execute(self, conf: JobConfig, input_path: str, output_path: str,
+                counters: Counters) -> None:
+        import numpy as np
+
+        from avenir_tpu.jobs.base import read_input
+        from avenir_tpu.ops import agg
+
+        delim = conf.field_delim_regex
+        rows = read_input(input_path, delim=delim)
+        attr_ords = conf.get_int_list("attr.list", None)
+        if attr_ords is None:
+            try:
+                schema = self.load_schema(conf)
+                attr_ords = [f.ordinal for f in schema.feature_fields
+                             if f.is_numeric]
+            except ValueError:
+                attr_ords = list(range(rows.shape[1] if rows.size else 0))
+        cond_ord = conf.get_int("cond.attr.ord")
+
+        if not rows.size or not attr_ords:
+            write_output(output_path, [])
+            return
+        vals = rows[:, attr_ords].astype(np.float32)
+        if cond_ord is not None:
+            cond_vals = [str(v) for v in rows[:, cond_ord]]
+            uniq = sorted(set(cond_vals))
+            cmap = {v: i for i, v in enumerate(uniq)}
+            labels = np.asarray([cmap[v] for v in cond_vals], np.int32)
+        else:
+            uniq = [""]
+            labels = np.zeros(len(rows), np.int32)
+        cnt, s1, s2 = (np.asarray(a) for a in agg.class_moments(
+            vals, labels, len(uniq)))
+
+        d = conf.field_delim
+        lines: List[str] = []
+        for ai, aord in enumerate(attr_ords):
+            col = vals[:, ai]
+            for ci, cval in enumerate(uniq):
+                n = cnt[ci]
+                if not n:
+                    continue
+                mean = s1[ci, ai] / n
+                var = max(s2[ci, ai] / n - mean * mean, 0.0)
+                sub = col[labels == ci]
+                fields = [str(aord)] + ([cval] if cond_ord is not None else [])
+                fields += [_fmt(float(n)), _fmt(float(s1[ci, ai])),
+                           _fmt(float(s2[ci, ai])), _fmt(float(mean)),
+                           _fmt(float(var)), _fmt(float(np.sqrt(var))),
+                           _fmt(float(sub.min())), _fmt(float(sub.max()))]
+                lines.append(d.join(fields))
+        write_output(output_path, lines)
+        counters.set("Records", "Processed", len(rows))

@@ -329,11 +329,162 @@ def _viterbi_batch(log_a: jax.Array, log_b: jax.Array, log_pi: jax.Array,
     return jax.vmap(decode_one)(obs)
 
 
-class ViterbiDecoder:
-    """Batch Viterbi decoding over an HMM model."""
+_NEG = -1.0e30          # max-plus "-inf" kept finite (NaN-safe under XLA)
 
-    def __init__(self, model: HMMModel):
+
+def _step_matrices(log_a: jax.Array, log_b: jax.Array, obs: jax.Array) -> jax.Array:
+    """[T-1, S, S] max-plus step matrices M_t[i,j] = A[i,j] + B[j, o_t] for
+    t ≥ 1; padded steps (o_t < 0) become the max-plus identity (0 diagonal,
+    -BIG elsewhere) so δ is carried unchanged."""
+    s = log_a.shape[0]
+    steps = log_a[None, :, :] + log_b[:, jnp.maximum(obs[1:], 0)].T[:, None, :]
+    eye = jnp.where(jnp.eye(s, dtype=bool), 0.0, _NEG)
+    return jnp.where((obs[1:] >= 0)[:, None, None], steps, eye[None])
+
+
+def _maxplus(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(a ⊗ b)[i,j] = max_k a[i,k] + b[k,j] — the associative max-plus
+    matrix product underlying the Viterbi recurrence."""
+    return jnp.max(a[..., :, :, None] + b[..., None, :, :], axis=-2)
+
+
+def _viterbi_assoc_batch(log_a: jax.Array, log_b: jax.Array, log_pi: jax.Array,
+                         obs: jax.Array) -> jax.Array:
+    """Log-depth Viterbi: ``associative_scan`` over max-plus step matrices.
+
+    Same results as :func:`_viterbi_batch` but O(log T) depth at O(T·S³)
+    work — the long-sequence form (SURVEY.md §2.12: 'associative-scan for
+    the max-plus recurrence if long sequences matter'). Backpointers are
+    recomputed in parallel from the prefix δ's, so only the final [T]
+    backtrack is sequential.
+    """
+    s = log_a.shape[0]
+
+    def decode_one(o):
+        valid0 = o[0] >= 0
+        delta0 = jnp.where(valid0, log_pi + log_b[:, jnp.maximum(o[0], 0)],
+                           jnp.zeros(s))
+        steps = _step_matrices(log_a, log_b, o)               # [T-1, S, S]
+        prefix = jax.lax.associative_scan(_maxplus, steps)    # [T-1, S, S]
+        # δ_t for t ≥ 1, all at once: δ_t = δ_0 ⊗ prefix_t
+        deltas = jnp.max(delta0[None, :, None] + prefix, axis=1)   # [T-1, S]
+        all_deltas = jnp.concatenate([delta0[None], deltas])       # [T, S]
+        # backpointers in parallel: ψ_t[j] = argmax_i δ_{t-1}[i] + M_t[i,j]
+        ptrs = jnp.argmax(all_deltas[:-1, :, None] + steps, axis=1)  # [T-1, S]
+        # padded steps have identity M: argmax column j is j (carry) ✓
+        last = jnp.argmax(all_deltas[-1])
+
+        def back(state, ptr):
+            prev = ptr[state]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, last, ptrs, reverse=True)
+        path = jnp.concatenate([path_rev, jnp.array([last])])
+        return jnp.where(o >= 0, path, -1)
+
+    return jax.vmap(decode_one)(obs)
+
+
+def viterbi_time_sharded(log_a: jax.Array, log_b: jax.Array, log_pi: jax.Array,
+                         obs_row: jax.Array, mesh, axis: str = "data"
+                         ) -> jax.Array:
+    """Context-parallel Viterbi: ONE long sequence with its time axis
+    sharded over a mesh axis.
+
+    The sequence-parallelism pattern the task's long-context requirement
+    maps to in this framework: each device runs a local ``associative_scan``
+    over its chunk of max-plus step matrices, a single ``all_gather`` of the
+    [D, S, S] per-chunk products (ICI/DCN traffic independent of T) gives
+    every device its exclusive offset, and local prefixes are rebased — the
+    max-plus analog of blockwise-parallel attention's chunked softmax
+    rebasing. Backtrack pointers are computed locally and the final [T]
+    pointer chase runs once, after gather.
+
+    obs_row: [T] observation codes (−1 pad), T divisible by the axis size.
+    Returns [T] state path.
+    """
+    import functools as _ft
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    s = log_a.shape[0]
+    d = mesh.shape[axis]
+    ring = [(i, (i + 1) % d) for i in range(d)]
+
+    @_ft.partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P(), P(axis)),
+                 out_specs=(P(axis), P(axis)))
+    def forward(la, lb, lpi, o_loc):
+        # o_loc [L = T/D]: chunk d's step matrices cover the transitions
+        # INTO its positions; the first one needs the previous chunk's last
+        # observation (one scalar ppermute hop around the ring)
+        idx = jax.lax.axis_index(axis)
+        prev_tail = jax.lax.ppermute(o_loc[-1], axis, ring)
+        o_ext = jnp.concatenate([prev_tail[None], o_loc])      # [L + 1]
+        steps = _step_matrices(la, lb, o_ext)                  # [L, S, S]
+        # global position 0 has no incoming transition: identity
+        eye = jnp.where(jnp.eye(s, dtype=bool), 0.0, _NEG)
+        steps = steps.at[0].set(jnp.where(idx == 0, eye, steps[0]))
+        prefix = jax.lax.associative_scan(_maxplus, steps)     # [L, S, S]
+        # exclusive offset = max-plus product of all previous chunks' totals:
+        # ONE [D, S, S] all_gather — cross-device traffic independent of T
+        totals = jax.lax.all_gather(prefix[-1], axis)          # [D, S, S]
+
+        def offset_scan(carry, x):
+            return _maxplus(carry, x), carry
+
+        init = jax.lax.pcast(eye, (axis,), to="varying")
+        _, excl = jax.lax.scan(offset_scan, init, totals)      # [D, S, S]
+        global_prefix = _maxplus(excl[idx][None], prefix)      # [L, S, S]
+        # δ_t = δ_0 ⊗ (M_1 … M_t); δ_0 from the replicated first observation
+        o0 = jax.lax.all_gather(o_loc[0], axis)[0]
+        delta0 = jnp.where(o0 >= 0, lpi + lb[:, jnp.maximum(o0, 0)],
+                           jnp.zeros(s))
+        deltas = jnp.max(delta0[None, :, None] + global_prefix, axis=1)  # [L, S]
+        # backpointers need δ_{t-1}: shift deltas by one along the ring
+        prev_last = jax.lax.ppermute(deltas[-1], axis, ring)
+        delta_prev = jnp.concatenate([prev_last[None], deltas[:-1]])
+        delta_prev = jnp.where(idx == 0,
+                               jnp.concatenate([delta0[None], deltas[:-1]]),
+                               delta_prev)
+        # position 0 overall: ψ unused (identity step makes argmax = j)
+        psi = jnp.argmax(delta_prev[:, :, None] + steps, axis=1)  # [L, S]
+        return deltas, psi
+
+    deltas, psi = forward(log_a, log_b, log_pi,
+                          jnp.asarray(obs_row, jnp.int32))
+
+    @jax.jit
+    def backtrack(deltas, psi):
+        last = jnp.argmax(deltas[-1])
+
+        def back(state, ptr):
+            prev = ptr[state]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, last, psi[1:], reverse=True)
+        return jnp.concatenate([path_rev, jnp.array([last])])
+
+    path = np.asarray(backtrack(deltas, psi))
+    valid = np.asarray(obs_row) >= 0
+    return np.where(valid, path, -1)
+
+
+class ViterbiDecoder:
+    """Batch Viterbi decoding over an HMM model.
+
+    ``method``: ``"scan"`` (sequential ``lax.scan`` over time, O(T·S²) work —
+    the default for typical short per-record sequences) or ``"assoc"``
+    (log-depth ``associative_scan`` over max-plus step matrices, O(T·S³)
+    work — for long sequences). :func:`viterbi_time_sharded` additionally
+    shards one sequence's time axis over a device mesh."""
+
+    def __init__(self, model: HMMModel, method: str = "scan"):
+        if method not in ("scan", "assoc"):
+            raise ValueError(f"unknown viterbi method {method!r}")
         self.model = model
+        self.method = method
         eps = 1e-12
         self._log_a = jnp.asarray(np.log(np.maximum(model.transition, eps)), jnp.float32)
         self._log_b = jnp.asarray(np.log(np.maximum(model.emission, eps)), jnp.float32)
@@ -342,8 +493,9 @@ class ViterbiDecoder:
 
     def decode_codes(self, obs: np.ndarray) -> np.ndarray:
         """[R, T] obs codes (−1 pad) → [R, T] state codes (−1 pad)."""
-        return np.asarray(_viterbi_batch(self._log_a, self._log_b, self._log_pi,
-                                         jnp.asarray(obs, jnp.int32)))
+        fn = _viterbi_batch if self.method == "scan" else _viterbi_assoc_batch
+        return np.asarray(fn(self._log_a, self._log_b, self._log_pi,
+                             jnp.asarray(obs, jnp.int32)))
 
     def decode(self, obs_seqs: Sequence[Sequence[str]]) -> List[List[str]]:
         t = max((len(s) for s in obs_seqs), default=0)

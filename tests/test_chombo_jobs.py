@@ -136,3 +136,45 @@ def test_price_optimize_runbook_loop(tmp_path, bandit_job, props, n_rounds,
                 n_good += 1
         assert n_good >= int(0.75 * len(sim.products)), \
             f"only {n_good}/{len(sim.products)} products near-optimal"
+
+
+def test_numerical_attr_stats_conditioned(tmp_path):
+    # the Fisher usage: per-(attr, classVal) count/mean/var/std/min/max
+    rng = np.random.default_rng(3)
+    rows = []
+    for _ in range(500):
+        cls = rng.choice(["a", "b"])
+        x = rng.normal(2.0 if cls == "a" else 5.0, 1.0)
+        y = rng.normal(-1.0, 0.5)
+        rows.append(f"{x:.5f},{cls},{y:.5f}")
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "data.txt").write_text("\n".join(rows) + "\n")
+    conf = JobConfig({"attr.list": "0,2", "cond.attr.ord": "1"})
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        conf, str(tmp_path / "in"), str(tmp_path / "out"))
+    out = {}
+    for line in read_lines(str(tmp_path / "out")):
+        f = line.split(",")
+        # attr, cond, count, sum, sumSq, mean, var, std, min, max
+        out[(f[0], f[1])] = [float(v) for v in f[2:]]
+    assert set(out) == {("0", "a"), ("0", "b"), ("2", "a"), ("2", "b")}
+    assert abs(out[("0", "a")][3] - 2.0) < 0.3      # mean
+    assert abs(out[("0", "b")][3] - 5.0) < 0.3
+    assert abs(out[("2", "a")][5] - 0.5) < 0.15     # std
+    n_a = out[("0", "a")][0]
+    n_b = out[("0", "b")][0]
+    assert n_a + n_b == 500
+    assert out[("0", "a")][6] <= 2.0 <= out[("0", "a")][7]   # min ≤ μ ≤ max
+
+
+def test_numerical_attr_stats_unconditioned(tmp_path):
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "d.txt").write_text("1,10\n2,20\n3,30\n")
+    conf = JobConfig({"attr.list": "0,1"})
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        conf, str(tmp_path / "in"), str(tmp_path / "out"))
+    out = {l.split(",")[0]: l.split(",") for l in read_lines(str(tmp_path / "out"))}
+    # attr, count, sum, sumSq, mean, var, std, min, max
+    assert float(out["0"][4]) == pytest.approx(2.0)
+    assert float(out["1"][2]) == pytest.approx(60.0)
+    assert float(out["1"][8]) == pytest.approx(30.0)

@@ -137,3 +137,28 @@ def test_sharded_lr_step_matches_dense(rng):
     grad = x.T @ (y - p) / n - 0.01 * w0
     w_ref = w0 + 0.5 * grad
     np.testing.assert_allclose(w_sh, w_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_hybrid_mesh_single_slice_fallback():
+    # single-slice (test) environment: make_hybrid_mesh must reduce to a
+    # plain ICI mesh usable by every estimator
+    from avenir_tpu.parallel import mesh as pmesh
+    m = pmesh.make_hybrid_mesh(("data", "model"), ici_shape=(4, 2))
+    assert m.shape == {"data": 4, "model": 2}
+    m1 = pmesh.make_hybrid_mesh(("data",))
+    assert m1.shape["data"] == 8
+
+
+def test_init_distributed_single_host_noop():
+    from avenir_tpu.parallel import mesh as pmesh
+    assert pmesh.init_distributed() == 0
+
+
+def test_process_local_batch_single_process():
+    import numpy as np
+    from avenir_tpu.parallel import mesh as pmesh
+    m = pmesh.make_mesh(("data",))
+    arr = np.arange(20, dtype=np.int32).reshape(10, 2)
+    out = pmesh.process_local_batch(m, arr)
+    assert out.shape[0] % m.shape["data"] == 0
+    np.testing.assert_array_equal(np.asarray(out)[:10], arr)

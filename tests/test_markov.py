@@ -154,3 +154,46 @@ def test_viterbi_state_predictor_lines():
     assert lines[1] == "id2,L"
     pred2 = mk.ViterbiStatePredictor(model, pair_output=True)
     assert pred2.predict_lines([["id3", "u", "d"]])[0] == "id3,u:H,d:L"
+
+
+def _random_hmm(rng, s=5, v=7):
+    a = rng.dirichlet(np.ones(s), size=s)
+    b = rng.dirichlet(np.ones(v), size=s)
+    pi = rng.dirichlet(np.ones(s))
+    return a, b, pi
+
+
+def test_viterbi_assoc_matches_scan(rng):
+    from avenir_tpu.models.markov import (HMMModel, ViterbiDecoder)
+    a, b, pi = _random_hmm(rng)
+    model = HMMModel(states=[f"s{i}" for i in range(5)],
+                     observations=[f"o{i}" for i in range(7)],
+                     transition=a, emission=b, initial=pi)
+    obs = rng.integers(0, 7, size=(12, 40)).astype(np.int32)
+    obs[3, 25:] = -1            # ragged pads
+    obs[7, 10:] = -1
+    seq = ViterbiDecoder(model, method="scan").decode_codes(obs)
+    assoc = ViterbiDecoder(model, method="assoc").decode_codes(obs)
+    np.testing.assert_array_equal(seq, assoc)
+
+
+def test_viterbi_time_sharded_matches_sequential(rng):
+    import jax.numpy as jnp
+    from avenir_tpu.models.markov import (_viterbi_batch, viterbi_time_sharded)
+    from avenir_tpu.parallel import mesh as pmesh
+    a, b, pi = _random_hmm(rng, s=4, v=6)
+    eps = 1e-12
+    la = jnp.asarray(np.log(np.maximum(a, eps)), jnp.float32)
+    lb = jnp.asarray(np.log(np.maximum(b, eps)), jnp.float32)
+    lpi = jnp.asarray(np.log(np.maximum(pi, eps)), jnp.float32)
+    t = 8 * 32                   # one long sequence, time axis sharded 8-way
+    obs = rng.integers(0, 6, size=t).astype(np.int32)
+    m = pmesh.make_mesh(("data",))
+    path_sharded = viterbi_time_sharded(la, lb, lpi, obs, m, axis="data")
+    path_seq = np.asarray(_viterbi_batch(la, lb, lpi,
+                                         jnp.asarray(obs[None], jnp.int32)))[0]
+    # tie-breaking between equal-score paths can differ; scores must match
+    score = lambda p: (float(lpi[p[0]] + lb[p[0], obs[0]])
+                       + sum(float(la[p[i-1], p[i]] + lb[p[i], obs[i]])
+                             for i in range(1, t)))
+    assert score(path_sharded) == pytest.approx(score(path_seq), abs=1e-3)
