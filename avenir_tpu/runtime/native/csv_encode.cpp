@@ -15,11 +15,14 @@
 //
 // Build: g++ -O3 -shared -fPIC (driven by avenir_tpu/runtime/native.py).
 
+#include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -51,10 +54,180 @@ struct ColumnSpec {
 
 bool parse_double(const char* s, size_t n, double* out) {
   if (n == 0) return false;
-  std::string tmp(s, n);
+  // fields are short: stack buffer avoids a heap allocation per field
+  char tmp[64];
+  if (n < sizeof(tmp)) {
+    memcpy(tmp, s, n);
+    tmp[n] = '\0';
+    char* end = nullptr;
+    *out = std::strtod(tmp, &end);
+    return end == tmp + n;
+  }
+  std::string big(s, n);
   char* end = nullptr;
-  *out = std::strtod(tmp.c_str(), &end);
-  return end == tmp.c_str() + tmp.size();
+  *out = std::strtod(big.c_str(), &end);
+  return end == big.c_str() + big.size();
+}
+
+std::vector<ColumnSpec> build_specs(
+    const int32_t* kinds, const int32_t* ordinals,
+    const double* bucket_widths, const int64_t* bin_offsets,
+    const int32_t* n_bins, int32_t nspec, const char* vocab_blob) {
+  std::vector<ColumnSpec> specs(nspec);
+  const char* vb = vocab_blob;
+  for (int32_t i = 0; i < nspec; ++i) {
+    ColumnSpec& c = specs[i];
+    c.kind = kinds[i];
+    c.ordinal = ordinals[i];
+    c.bucket_width = bucket_widths[i];
+    c.bin_offset = bin_offsets[i];
+    c.n_bins = n_bins[i];
+    if (c.kind == kCategorical || c.kind == kLabel) {
+      int32_t code = 0;
+      std::string cur;
+      while (*vb != '\x1e') {
+        if (*vb == '\x1f') {
+          c.vocab.emplace(cur, code++);
+          cur.clear();
+        } else {
+          cur.push_back(*vb);
+        }
+        ++vb;
+      }
+      ++vb;  // skip column terminator
+    }
+  }
+  return specs;
+}
+
+std::vector<int32_t> build_slots(const std::vector<ColumnSpec>& specs) {
+  std::vector<int32_t> slot(specs.size(), 0);
+  int32_t bi = 0, ci = 0;
+  for (size_t i = 0; i < specs.size(); ++i) {
+    if (specs[i].kind == kCategorical || specs[i].kind == kBinnedNumeric)
+      slot[i] = bi++;
+    else if (specs[i].kind == kContinuous)
+      slot[i] = ci++;
+  }
+  return slot;
+}
+
+// Encode records in buf[range_begin:range_end] (newline-aligned) writing
+// rows starting at row_start. Returns rows encoded or a negative error code
+// with *err_row set to the ABSOLUTE offending row index.
+long encode_range(
+    const char* buf, const char* range_begin, const char* range_end,
+    char delim, int32_t ncols,
+    const std::vector<ColumnSpec>& specs, const std::vector<int32_t>& slot,
+    int32_t* codes_out, long n_binned, float* cont_out, long n_cont,
+    int32_t* labels_out, int64_t* id_off_out, int32_t* id_len_out,
+    long row_start, long max_rows, long* err_row) {
+  const int32_t nspec = static_cast<int32_t>(specs.size());
+  std::vector<const char*> starts(ncols);
+  std::vector<size_t> lens(ncols);
+  std::string lookup;  // reused across rows: no per-field heap allocation
+  long row = row_start;
+  const char* p = range_begin;
+  const char* end = range_end;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* trimmed = line_end;
+    if (trimmed > p && trimmed[-1] == '\r') --trimmed;
+    if (trimmed == p) {  // blank line
+      p = nl ? nl + 1 : end;
+      continue;
+    }
+    if (row >= max_rows) {
+      *err_row = row;
+      return kErrTooManyRows;
+    }
+    int32_t f = 0;
+    const char* fs = p;
+    for (const char* q = p; q <= trimmed; ++q) {
+      if (q == trimmed || *q == delim) {
+        if (f < ncols) {
+          starts[f] = fs;
+          lens[f] = static_cast<size_t>(q - fs);
+        }
+        ++f;
+        fs = q + 1;
+      }
+    }
+    if (f != ncols) {
+      *err_row = row;
+      return kErrRagged;
+    }
+    for (int32_t i = 0; i < nspec; ++i) {
+      const ColumnSpec& c = specs[i];
+      const char* s = starts[c.ordinal];
+      size_t n = lens[c.ordinal];
+      switch (c.kind) {
+        case kCategorical: {
+          lookup.assign(s, n);
+          auto it = c.vocab.find(lookup);
+          codes_out[row * n_binned + slot[i]] =
+              it == c.vocab.end() ? c.n_bins - 1 : it->second;
+          break;
+        }
+        case kBinnedNumeric: {
+          double v;
+          if (!parse_double(s, n, &v)) {
+            *err_row = row;
+            return kErrBadNumber;
+          }
+          int64_t b = static_cast<int64_t>(std::floor(v / c.bucket_width)) -
+                      c.bin_offset;
+          if (b < 0) b = 0;
+          if (b >= c.n_bins) b = c.n_bins - 1;
+          codes_out[row * n_binned + slot[i]] = static_cast<int32_t>(b);
+          break;
+        }
+        case kContinuous: {
+          double v;
+          if (!parse_double(s, n, &v)) {
+            *err_row = row;
+            return kErrBadNumber;
+          }
+          cont_out[row * n_cont + slot[i]] = static_cast<float>(v);
+          break;
+        }
+        case kLabel: {
+          lookup.assign(s, n);
+          auto it = c.vocab.find(lookup);
+          if (it == c.vocab.end()) {
+            *err_row = row;
+            return kErrUnknownLabel;
+          }
+          if (labels_out) labels_out[row] = it->second;
+          break;
+        }
+        case kId: {
+          if (id_off_out) {
+            id_off_out[row] = static_cast<int64_t>(s - buf);
+            id_len_out[row] = static_cast<int32_t>(n);
+          }
+          break;
+        }
+      }
+    }
+    ++row;
+    p = nl ? nl + 1 : end;
+  }
+  return row - row_start;
+}
+
+long count_rows_range(const char* p, const char* end) {
+  long rows = 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* trimmed = line_end;
+    if (trimmed > p && trimmed[-1] == '\r') --trimmed;
+    if (trimmed > p) ++rows;
+    p = nl ? nl + 1 : end;
+  }
+  return rows;
 }
 
 }  // namespace
@@ -83,152 +256,101 @@ long avenir_csv_encode(
     int32_t* labels_out,
     int64_t* id_off_out, int32_t* id_len_out,
     long max_rows, long* err_row) {
-  // build specs
-  std::vector<ColumnSpec> specs(nspec);
-  const char* vb = vocab_blob;
-  for (int32_t i = 0; i < nspec; ++i) {
-    ColumnSpec& c = specs[i];
-    c.kind = kinds[i];
-    c.ordinal = ordinals[i];
-    c.bucket_width = bucket_widths[i];
-    c.bin_offset = bin_offsets[i];
-    c.n_bins = n_bins[i];
-    if (c.kind == kCategorical || c.kind == kLabel) {
-      int32_t code = 0;
-      std::string cur;
-      while (*vb != '\x1e') {
-        if (*vb == '\x1f') {
-          c.vocab.emplace(cur, code++);
-          cur.clear();
-        } else {
-          cur.push_back(*vb);
-        }
-        ++vb;
-      }
-      ++vb;  // skip column terminator
-    }
+  auto specs = build_specs(kinds, ordinals, bucket_widths, bin_offsets,
+                           n_bins, nspec, vocab_blob);
+  auto slot = build_slots(specs);
+  return encode_range(buf, buf, buf + len, delim, ncols, specs, slot,
+                      codes_out, n_binned, cont_out, n_cont, labels_out,
+                      id_off_out, id_len_out, 0, max_rows, err_row);
+}
+
+// Multithreaded variant: splits the buffer into newline-aligned ranges,
+// prefix-sums per-range row counts, then encodes ranges in parallel into
+// the shared outputs — deterministic row order identical to the
+// single-threaded path (the analog of the reference's per-HDFS-split mapper
+// parallelism, in one process).
+long avenir_csv_encode_mt(
+    const char* buf, long len, char delim, int32_t ncols,
+    const int32_t* kinds, const int32_t* ordinals,
+    const double* bucket_widths, const int64_t* bin_offsets,
+    const int32_t* n_bins, int32_t nspec,
+    const char* vocab_blob,
+    int32_t* codes_out, long n_binned,
+    float* cont_out, long n_cont,
+    int32_t* labels_out,
+    int64_t* id_off_out, int32_t* id_len_out,
+    long max_rows, long* err_row, int32_t nthreads) {
+  if (nthreads <= 1 || len < (1 << 20)) {
+    return avenir_csv_encode(buf, len, delim, ncols, kinds, ordinals,
+                             bucket_widths, bin_offsets, n_bins, nspec,
+                             vocab_blob, codes_out, n_binned, cont_out,
+                             n_cont, labels_out, id_off_out, id_len_out,
+                             max_rows, err_row);
   }
-  // spec index -> output slot
-  std::vector<int32_t> slot(nspec, 0);
+  auto specs = build_specs(kinds, ordinals, bucket_widths, bin_offsets,
+                           n_bins, nspec, vocab_blob);
+  auto slot = build_slots(specs);
+
+  // newline-aligned range boundaries
+  const char* end = buf + len;
+  std::vector<const char*> bounds;
+  bounds.push_back(buf);
+  for (int32_t t = 1; t < nthreads; ++t) {
+    const char* guess = buf + (len * t) / nthreads;
+    if (guess <= bounds.back()) continue;
+    const char* nl = static_cast<const char*>(
+        memchr(guess, '\n', static_cast<size_t>(end - guess)));
+    const char* b = nl ? nl + 1 : end;
+    if (b > bounds.back() && b < end) bounds.push_back(b);
+  }
+  bounds.push_back(end);
+  const int nr = static_cast<int>(bounds.size()) - 1;
+
+  // per-range row counts -> absolute row offsets (parallel count pass)
+  std::vector<long> counts(nr, 0);
   {
-    int32_t bi = 0, ci = 0;
-    for (int32_t i = 0; i < nspec; ++i) {
-      if (specs[i].kind == kCategorical || specs[i].kind == kBinnedNumeric)
-        slot[i] = bi++;
-      else if (specs[i].kind == kContinuous)
-        slot[i] = ci++;
-    }
+    std::vector<std::thread> ts;
+    for (int r = 0; r < nr; ++r)
+      ts.emplace_back([&, r] { counts[r] = count_rows_range(bounds[r], bounds[r + 1]); });
+    for (auto& t : ts) t.join();
+  }
+  std::vector<long> offsets(nr + 1, 0);
+  for (int r = 0; r < nr; ++r) offsets[r + 1] = offsets[r] + counts[r];
+  if (offsets[nr] > max_rows) {
+    *err_row = max_rows;
+    return kErrTooManyRows;
   }
 
-  std::vector<const char*> starts(ncols);
-  std::vector<size_t> lens(ncols);
-  long row = 0;
-  const char* p = buf;
-  const char* end = buf + len;
-  while (p < end) {
-    // locate line
-    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-    const char* line_end = nl ? nl : end;
-    // strip CR
-    const char* trimmed = line_end;
-    if (trimmed > p && trimmed[-1] == '\r') --trimmed;
-    if (trimmed == p) {  // blank line
-      p = nl ? nl + 1 : end;
-      continue;
+  // parallel encode; first (lowest-row) error wins
+  std::vector<long> errs(nr, 0);
+  std::vector<long> err_rows(nr, 0);
+  {
+    std::vector<std::thread> ts;
+    for (int r = 0; r < nr; ++r) {
+      ts.emplace_back([&, r] {
+        long e = 0;
+        long got = encode_range(buf, bounds[r], bounds[r + 1], delim, ncols,
+                                specs, slot, codes_out, n_binned, cont_out,
+                                n_cont, labels_out, id_off_out, id_len_out,
+                                offsets[r], max_rows, &e);
+        errs[r] = got < 0 ? got : 0;
+        err_rows[r] = e;
+      });
     }
-    if (row >= max_rows) {
-      *err_row = row;
-      return kErrTooManyRows;
-    }
-    // split fields
-    int32_t f = 0;
-    const char* fs = p;
-    for (const char* q = p; q <= trimmed; ++q) {
-      if (q == trimmed || *q == delim) {
-        if (f < ncols) {
-          starts[f] = fs;
-          lens[f] = static_cast<size_t>(q - fs);
-        }
-        ++f;
-        fs = q + 1;
-      }
-    }
-    if (f != ncols) {
-      *err_row = row;
-      return kErrRagged;
-    }
-    // encode
-    for (int32_t i = 0; i < nspec; ++i) {
-      const ColumnSpec& c = specs[i];
-      const char* s = starts[c.ordinal];
-      size_t n = lens[c.ordinal];
-      switch (c.kind) {
-        case kCategorical: {
-          auto it = c.vocab.find(std::string(s, n));
-          codes_out[row * n_binned + slot[i]] =
-              it == c.vocab.end() ? c.n_bins - 1 : it->second;
-          break;
-        }
-        case kBinnedNumeric: {
-          double v;
-          if (!parse_double(s, n, &v)) {
-            *err_row = row;
-            return kErrBadNumber;
-          }
-          int64_t b = static_cast<int64_t>(std::floor(v / c.bucket_width)) -
-                      c.bin_offset;
-          if (b < 0) b = 0;
-          if (b >= c.n_bins) b = c.n_bins - 1;
-          codes_out[row * n_binned + slot[i]] = static_cast<int32_t>(b);
-          break;
-        }
-        case kContinuous: {
-          double v;
-          if (!parse_double(s, n, &v)) {
-            *err_row = row;
-            return kErrBadNumber;
-          }
-          cont_out[row * n_cont + slot[i]] = static_cast<float>(v);
-          break;
-        }
-        case kLabel: {
-          auto it = c.vocab.find(std::string(s, n));
-          if (it == c.vocab.end()) {
-            *err_row = row;
-            return kErrUnknownLabel;
-          }
-          if (labels_out) labels_out[row] = it->second;
-          break;
-        }
-        case kId: {
-          if (id_off_out) {
-            id_off_out[row] = static_cast<int64_t>(s - buf);
-            id_len_out[row] = static_cast<int32_t>(n);
-          }
-          break;
-        }
-      }
-    }
-    ++row;
-    p = nl ? nl + 1 : end;
+    for (auto& t : ts) t.join();
   }
-  return row;
+  for (int r = 0; r < nr; ++r) {
+    if (errs[r] < 0) {
+      *err_row = err_rows[r];
+      return errs[r];
+    }
+  }
+  return offsets[nr];
 }
 
 // Count newline-terminated records (for buffer pre-sizing).
 long avenir_csv_count_rows(const char* buf, long len) {
-  long rows = 0;
-  const char* p = buf;
-  const char* end = buf + len;
-  while (p < end) {
-    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-    const char* line_end = nl ? nl : end;
-    const char* trimmed = line_end;
-    if (trimmed > p && trimmed[-1] == '\r') --trimmed;
-    if (trimmed > p) ++rows;
-    p = nl ? nl + 1 : end;
-  }
-  return rows;
+  return count_rows_range(buf, buf + len);
 }
 
 }  // extern "C"

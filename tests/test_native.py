@@ -136,3 +136,27 @@ def test_native_ids_parity(built):
     assert nat.ids is not None
     np.testing.assert_array_equal(np.asarray(nat.ids, object),
                                   np.asarray(py_ds.ids, object))
+
+
+def test_native_mt_parity_large_buffer(built):
+    # > 1 MiB so the multithreaded path engages; row order and every output
+    # must be identical to both the single-threaded kernel and Python
+    rows = generate_churn(30000, seed=11)
+    enc, ds = _fitted(CHURN_SCHEMA_JSON, rows)
+    data = _csv_bytes(rows)
+    assert len(data) > (1 << 20)
+    out_mt = native.encode_bytes(data, enc, ncols=len(rows[0]), nthreads=8)
+    out_st = native.encode_bytes(data, enc, ncols=len(rows[0]), nthreads=1)
+    np.testing.assert_array_equal(out_mt.codes, out_st.codes)
+    np.testing.assert_array_equal(out_mt.codes, ds.codes)
+    np.testing.assert_array_equal(out_mt.labels, ds.labels)
+
+
+def test_native_mt_error_row_absolute(built):
+    rows = [list(r) for r in generate_churn(30000, seed=12)]
+    bad = 20011
+    rows[bad] = rows[bad][:-1] + ["zzz-not-a-class"]
+    enc, _ = _fitted(CHURN_SCHEMA_JSON, generate_churn(30000, seed=12))
+    data = _csv_bytes(rows)
+    with pytest.raises(ValueError, match=f"unknown class label at row {bad}"):
+        native.encode_bytes(data, enc, ncols=len(rows[0]), nthreads=8)
