@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""End-to-end pipeline benchmark: CSV bytes → native encode → device NB+MI.
+
+The north-star workload (BASELINE.md) is the hospital-readmission MI +
+Naive-Bayes pipeline over CSV with the reference's driver contract. bench.py
+measures the device aggregation alone; this measures the whole ingest path:
+chunked CSV parsing through the C++ data plane (runtime/native) overlapped
+with the jitted count kernels on chip.
+
+Usage: python -m benchmarks.e2e_pipeline [n_rows]   (default 20M)
+Prints one JSON line with end-to-end rows/sec and the ingest-only rate.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.core.encoding import DatasetEncoder
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.datagen.hosp_readmit import HOSP_SCHEMA_JSON, generate_hosp_readmit
+from avenir_tpu.ops import agg
+from avenir_tpu.runtime import native
+
+
+def make_csv_block(n_rows: int, seed: int) -> bytes:
+    rows = generate_hosp_readmit(n_rows, seed=seed)
+    return ("\n".join(",".join(r) for r in rows) + "\n").encode()
+
+
+def main():
+    n_target = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000_000
+    block_rows = 500_000
+    block = make_csv_block(block_rows, seed=1)      # one synthesized block,
+    n_blocks = max(n_target // block_rows, 1)       # streamed n_blocks times
+
+    enc = DatasetEncoder(FeatureSchema.from_json(HOSP_SCHEMA_JSON))
+    sample = generate_hosp_readmit(2000, seed=0)
+    ds0 = enc.fit_transform(sample)
+    ncols = len(sample[0])
+    assert native.is_available(), native.build_error()
+
+    f = ds0.codes.shape[1]
+    nb = int(ds0.n_bins.max())
+    n_classes = len(ds0.class_values)
+    pair_idx = np.array([(i, j) for i in range(f) for j in range(i + 1, f)],
+                        np.int32)
+    ci, cj = pair_idx[:, 0], pair_idx[:, 1]
+
+    def device_step(codes, labels):
+        return (agg.feature_class_counts(codes, labels, n_classes, nb),
+                agg.pair_class_counts(codes[:, ci], codes[:, cj], labels,
+                                      n_classes, nb))
+
+    # warm up compile + native path
+    d = native.encode_bytes(block, enc, ncols=ncols)
+    out = device_step(jnp.asarray(d.codes), jnp.asarray(d.labels))
+    jax.block_until_ready(out)
+
+    # ingest-only rate (best of 3, matching knn_qps.py)
+    ingest_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        native.encode_bytes(block, enc, ncols=ncols)
+        ingest_dt = min(ingest_dt, time.perf_counter() - t0)
+
+    # end-to-end: encode each block on host, dispatch async to device;
+    # device work of block i overlaps host encode of block i+1
+    t0 = time.perf_counter()
+    for _ in range(n_blocks):
+        d = native.encode_bytes(block, enc, ncols=ncols)
+        out = device_step(jnp.asarray(d.codes), jnp.asarray(d.labels))
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    total = n_blocks * block_rows
+
+    print(json.dumps({
+        "metric": "e2e_csv_nb_mi_pipeline",
+        "value": round(total / dt, 1),
+        "unit": "rows/sec/chip",
+        "rows": total,
+        "ingest_only_rows_per_sec": round(block_rows / ingest_dt, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
