@@ -80,6 +80,24 @@ def test_native_error_surfaces(built):
         native.encode_bytes(_csv_bytes(bad2), enc2, ncols=4)
 
 
+def test_native_negative_numbers_after_delim(built):
+    # regression: the SWAR field splitter's zero-byte detect must be exact —
+    # a positionally-approximate mask (borrow propagation) flagged any byte
+    # equal to delim^0x01 following a real delimiter, so ',-3.5' split into a
+    # phantom field and valid rows raised "ragged CSV record"
+    rows = generate_elearn(200, seed=11)
+    rng = np.random.default_rng(11)
+    for i in range(rows.shape[0]):           # negatives at varied offsets
+        for j in rng.choice(np.arange(1, rows.shape[1] - 1), size=3, replace=False):
+            if not rows[i, j].startswith("-"):
+                rows[i, j] = "-" + rows[i, j]
+    enc, _ = _fitted(ELEARN_SCHEMA_JSON, rows)
+    py = enc.transform(rows)
+    nat = native.encode_bytes(_csv_bytes(rows), enc, ncols=rows.shape[1])
+    np.testing.assert_array_equal(nat.codes, py.codes)
+    np.testing.assert_allclose(nat.cont, py.cont, rtol=1e-6)
+
+
 def test_native_crlf_and_blank_lines(built):
     rows = generate_churn(20, seed=4)
     enc, py_ds = _fitted(CHURN_SCHEMA_JSON, rows)
