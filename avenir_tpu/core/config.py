@@ -18,6 +18,12 @@ from __future__ import annotations
 from typing import Any, Dict, Iterable, List, Optional
 
 
+class ConfigError(ValueError):
+    """A deterministic configuration/schema error — the same inputs will
+    fail the same way, so retry layers must fail fast instead of retrying
+    (see utils/retry.py RetryPolicy.from_conf)."""
+
+
 class JobConfig:
     """Parsed properties file with typed getters.
 

@@ -162,7 +162,9 @@ class DatasetEncoder:
             missing = [f.name for f in self.binned_fields
                        if f.ordinal not in self.vocab and f.ordinal not in self.bin_offset]
             if missing or (self.class_field is not None and with_labels and not self.class_values):
-                raise RuntimeError(f"encoder not fitted and schema incomplete for fields: {missing}")
+                from avenir_tpu.core.config import ConfigError
+                raise ConfigError(
+                    f"encoder not fitted and schema incomplete for fields: {missing}")
         n = rows.shape[0]
         codes = np.zeros((n, len(self.binned_fields)), dtype=np.int32)
         for j, f in enumerate(self.binned_fields):

@@ -129,3 +129,58 @@ class Job:
         ds = enc.fit_transform(rows, with_labels=with_labels) if not enc._fitted \
             else enc.transform(rows, with_labels=with_labels)
         return enc, ds, rows
+
+    @staticmethod
+    def iter_encoded_retrying(conf: JobConfig, input_path: str,
+                              encoder: DatasetEncoder,
+                              counters: Counters,
+                              with_labels: bool = True) -> Iterator[EncodedDataset]:
+        """Stream encoded chunks with per-chunk retry — the streaming train
+        path, gated by ``stream.chunk.rows``.
+
+        The retried task is the whole read+parse+encode of one chunk,
+        addressed by (file, byte offset) exactly as a Hadoop map task is
+        addressed by its input split: on retry the task re-opens the file,
+        re-seeks, and re-reads, so transient I/O faults are covered along
+        with encode faults (policy from ``mapred.map.max.attempts``; the
+        read loop is owned here rather than delegated to
+        ``iter_input_chunks`` precisely because retries need seekable
+        addressing, which a generator cannot replay).
+
+        Requires a schema-complete encoder (vocabularies via
+        ``cardinality``, numeric ranges via ``min``/``max``), exactly the
+        contract the reference's mappers rely on — with an open vocabulary
+        the single-pass stream cannot assign stable codes, and
+        ``DatasetEncoder.transform`` raises ConfigError (non-retryable)."""
+        from avenir_tpu.core.csv_io import read_csv_string
+        from avenir_tpu.utils.retry import RetryPolicy, run_with_retry
+
+        policy = RetryPolicy.from_conf(conf)
+        chunk_rows = conf.get_int("stream.chunk.rows", 1_000_000)
+        delim = conf.field_delim_regex
+        i = 0
+        for f in input_files(input_path):
+            offset = 0
+            while True:
+                def task(path=f, off=offset):
+                    with open(path, "rb") as fh:
+                        fh.seek(off)
+                        lines: List[str] = []
+                        while len(lines) < chunk_rows:
+                            ln = fh.readline()
+                            if not ln:
+                                break
+                            if ln.strip():
+                                lines.append(ln.decode())
+                        end = fh.tell()
+                    if not lines:
+                        return end, None
+                    rows = read_csv_string("".join(lines), delim=delim)
+                    return end, encoder.transform(rows, with_labels=with_labels)
+
+                offset, ds = run_with_retry(
+                    task, policy=policy, counters=counters, task=f"chunk[{i}]")
+                if ds is None:
+                    break
+                i += 1
+                yield ds

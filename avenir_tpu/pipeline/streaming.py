@@ -188,3 +188,83 @@ class ReinforcementLearnerServer:
 
     def restore(self, blob: str) -> None:
         self.learner.set_state(json.loads(blob))
+
+
+# ---------------------------------------------------------------------------
+# supervision — the Storm worker-restart analog
+# ---------------------------------------------------------------------------
+
+class ServerSupervisor:
+    """Failure detection + elastic restart for the serving loop.
+
+    Storm restarts a crashed bolt worker but the reference's learner state is
+    per-bolt-instance in-memory and unreplicated, so a restart loses it
+    (SURVEY.md §3.5); replay of the in-flight message is governed by
+    ``replay.failed.message`` (the spout's fail hook is stubbed empty,
+    RedisSpout.java:103-106). Here the supervisor owns both halves properly:
+
+    - learner state is checkpointed every ``checkpoint_interval`` events and
+      restored into a fresh learner on restart (no state loss);
+    - a persistent crash loop is detected and surfaced after
+      ``max_restarts`` crashes *within one unstable window*: sustained
+      progress (``restart_reset_after`` consecutive events since the last
+      crash) resets the budget, so sporadic transient faults spread over a
+      long-lived loop never masquerade as a crash loop (elastic recovery,
+      not infinite flapping);
+    - the failed event itself is dropped, matching the deployed
+      ``replay.failed.message=false`` semantics — queue transports hand an
+      event over exactly once, so replay would need producer cooperation.
+
+    ``server_factory`` builds a fresh server (learner + queue bindings);
+    the supervisor restores the last checkpoint into it before resuming.
+    """
+
+    def __init__(self, server_factory: Callable[[], ReinforcementLearnerServer],
+                 checkpoint_interval: int = 64, max_restarts: int = 3,
+                 restart_reset_after: int = 1000):
+        self.server_factory = server_factory
+        self.checkpoint_interval = max(checkpoint_interval, 1)
+        self.max_restarts = max_restarts
+        self.restart_reset_after = max(restart_reset_after, 1)
+        self.restarts = 0
+        self.events_processed = 0
+        self.last_checkpoint: Optional[str] = None
+        self._server: Optional[ReinforcementLearnerServer] = None
+        self._events_since_crash = 0
+
+    @property
+    def server(self) -> ReinforcementLearnerServer:
+        if self._server is None:
+            self._server = self.server_factory()
+            if self.last_checkpoint is not None:
+                self._server.restore(self.last_checkpoint)
+        return self._server
+
+    def run(self, max_events: Optional[int] = None) -> int:
+        """Drive the serving loop to queue exhaustion (or ``max_events``),
+        restarting from the last checkpoint on crashes. Returns events
+        processed across all incarnations; raises the last error once
+        ``max_restarts`` is exceeded (crash-loop detection)."""
+        done = 0
+        while max_events is None or done < max_events:
+            srv = self.server
+            try:
+                if not srv.process_one():
+                    break
+                done += 1
+                self.events_processed += 1
+                self._events_since_crash += 1
+                if self._events_since_crash >= self.restart_reset_after:
+                    self.restarts = 0      # stable again: refill the budget
+                if self.events_processed % self.checkpoint_interval == 0:
+                    self.last_checkpoint = srv.checkpoint()
+            except Exception:
+                self.restarts += 1
+                self._events_since_crash = 0
+                self._server = None        # next access builds + restores
+                if self.restarts > self.max_restarts:
+                    raise
+        # final checkpoint so a subsequent supervisor resumes precisely
+        if self._server is not None:
+            self.last_checkpoint = self._server.checkpoint()
+        return done

@@ -1,0 +1,184 @@
+"""Failure detection and elastic retry — the task-retry layer.
+
+The reference delegates failure handling wholly to its cluster runtimes:
+Hadoop re-runs a failed map/reduce task on its input split up to
+``mapred.map.max.attempts`` times (resource/knn.properties:5-6 sets 2), and
+Storm optionally replays failed messages (``replay.failed.message`` —
+resource/boost_lead_generation_tutorial.txt:27; the spout's failed-message
+hook is stubbed at RedisSpout.java:103-106). There is no fault injection
+anywhere in the reference (SURVEY.md §5).
+
+Here the equivalent unit of work is a *chunk step* — one encoded chunk
+through a jitted aggregation kernel — so task retry becomes chunk retry:
+chunks are materialized values and every chunk step is a pure function of
+its chunk, so re-running a failed step is idempotent by construction (the
+framework's accumulate-per-chunk-then-merge discipline; contrast the
+reference's only unsafe spot, the single-reducer LR coefficient-file
+rewrite, SURVEY.md §5 "race detection").
+
+:class:`FaultInjector` is the fault-injection capability the reference
+lacks: deterministic fault schedules wrap any callable so tests can assert
+fault-free results survive injected crashes (tests/test_hardening.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple, TypeVar
+
+from avenir_tpu.utils.metrics import Counters
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+# counter names (the observability channel, as Hadoop publishes task retries)
+ATTEMPTS = ("Task", "attempts")
+FAILURES = ("Task", "failed.attempts")
+EXHAUSTED = ("Task", "exhausted")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Chunk/task retry policy.
+
+    ``max_attempts`` defaults to 2, the reference deployment's
+    ``mapred.map.max.attempts`` value. ``backoff_s`` is the sleep before
+    each re-attempt (0 for in-process compute retries; nonzero for I/O).
+    ``retryable`` filters which exception types are retried — anything else
+    propagates immediately (a schema error will not pass on attempt 2).
+    """
+
+    max_attempts: int = 2
+    backoff_s: float = 0.0
+    retryable: Tuple[type, ...] = (Exception,)
+    non_retryable: Tuple[type, ...] = ()
+
+    @classmethod
+    def from_conf(cls, conf) -> "RetryPolicy":
+        """Read the reference's property name (``mapred.map.max.attempts``)
+        with the framework name ``task.max.attempts`` as an alias.
+
+        Deterministic configuration errors (:class:`ConfigError` — e.g. a
+        schema too incomplete for streaming encode) are non-retryable: the
+        same attempt would fail the same way, and wrapping the clear error
+        in a TaskExhaustedError would bury it."""
+        from avenir_tpu.core.config import ConfigError
+
+        attempts = int(conf.get("task.max.attempts",
+                                conf.get("mapred.map.max.attempts", 2)))
+        backoff = float(conf.get("task.retry.backoff.sec", 0.0))
+        return cls(max_attempts=max(attempts, 1), backoff_s=backoff,
+                   non_retryable=(ConfigError,))
+
+
+class TaskExhaustedError(RuntimeError):
+    """A task failed on every attempt; carries the last underlying error."""
+
+    def __init__(self, task: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"task {task!r} failed after {attempts} attempts: {last!r}")
+        self.task = task
+        self.attempts = attempts
+        self.last = last
+
+
+def run_with_retry(fn: Callable[[], R], *, policy: RetryPolicy,
+                   counters: Optional[Counters] = None,
+                   task: str = "task") -> R:
+    """Run ``fn`` under the retry policy; raises TaskExhaustedError after the
+    final failed attempt. ``fn`` must be safe to re-run (pure, or idempotent
+    against external state)."""
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.max_attempts + 1):
+        if counters is not None:
+            counters.increment(*ATTEMPTS)
+        try:
+            return fn()
+        except policy.retryable as e:          # noqa: PERF203 — retry loop
+            if isinstance(e, policy.non_retryable):
+                raise                          # deterministic: fail fast
+            last = e
+            if counters is not None:
+                counters.increment(*FAILURES)
+            log.warning("task %s attempt %d/%d failed: %r",
+                        task, attempt, policy.max_attempts, e)
+            if attempt < policy.max_attempts and policy.backoff_s > 0:
+                time.sleep(policy.backoff_s)
+    if counters is not None:
+        counters.increment(*EXHAUSTED)
+    assert last is not None
+    raise TaskExhaustedError(task, policy.max_attempts, last)
+
+
+def process_chunks(chunks: Iterable[T], step: Callable[[T], R], *,
+                   policy: Optional[RetryPolicy] = None,
+                   counters: Optional[Counters] = None,
+                   task: str = "chunk") -> List[R]:
+    """Run ``step`` over each chunk with per-chunk retry — the MR task-retry
+    analog (a failed map task re-runs on its split; a failed chunk step
+    re-runs on its chunk). Returns the per-chunk results in order."""
+    policy = policy or RetryPolicy()
+    out: List[R] = []
+    for i, chunk in enumerate(chunks):
+        out.append(run_with_retry(
+            lambda c=chunk: step(c), policy=policy, counters=counters,
+            task=f"{task}[{i}]"))
+    return out
+
+
+class InjectedFault(RuntimeError):
+    """Raised by FaultInjector on scheduled invocations."""
+
+
+class FaultInjector:
+    """Deterministic fault injection for tests and chaos drills.
+
+    Wraps a callable; raises :class:`InjectedFault` on the 1-based
+    invocation numbers in ``fail_on`` — the deterministic analog of a flaky
+    worker. A single scheduled number models a transient fault (the retry
+    then succeeds); consecutive numbers model a persistent fault that
+    defeats an N-attempt policy.
+    """
+
+    def __init__(self, fn: Callable[..., R], fail_on: Sequence[int],
+                 exc: Callable[[], BaseException] = lambda: InjectedFault("injected")):
+        self._fn = fn
+        self._fail_on = frozenset(fail_on)
+        self._exc = exc
+        self.calls = 0
+        self.faults_fired = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls in self._fail_on:
+            self.faults_fired += 1
+            raise self._exc()
+        return self._fn(*args, **kwargs)
+
+
+@dataclass
+class HeartbeatMonitor:
+    """Failure *detection* for long-running host loops: callers beat on
+    progress; :meth:`stalled` reports whether the loop has gone silent for
+    longer than ``timeout_s`` (the JobTracker's task-timeout analog,
+    decoupled from any cluster runtime). Pure bookkeeping — the policy
+    (restart, alert) belongs to the supervisor that polls it."""
+
+    timeout_s: float = 600.0
+    clock: Callable[[], float] = time.monotonic
+    last_beat: float = field(default=0.0)
+    beats: int = 0
+
+    def __post_init__(self):
+        self.last_beat = self.clock()
+
+    def beat(self) -> None:
+        self.beats += 1
+        self.last_beat = self.clock()
+
+    def stalled(self) -> bool:
+        return (self.clock() - self.last_beat) > self.timeout_s

@@ -221,3 +221,317 @@ def test_checkpoint_file_key_and_orphan_sweep(tmp_path):
     os.makedirs(os.path.join(d, ".ckpt_orphan"))
     CheckpointManager(d, keep=3)
     assert not os.path.exists(os.path.join(d, ".ckpt_orphan"))
+
+
+# ---------------------------------------------------------------------------
+# failure detection / elastic retry / fault injection (utils/retry.py)
+# ---------------------------------------------------------------------------
+
+def test_faulted_chunk_processing_matches_clean_run():
+    # chunk retry is the MR task-retry analog: transient faults on two chunk
+    # steps must not change the aggregate (chunks re-run idempotently)
+    from avenir_tpu.utils.metrics import Counters
+    from avenir_tpu.utils.retry import FaultInjector, RetryPolicy, process_chunks
+
+    chunks = [np.full(10, i, np.int64) for i in range(8)]
+    clean = [int(c.sum()) for c in chunks]
+    step = FaultInjector(lambda c: int(c.sum()), fail_on=[2, 7])
+    counters = Counters()
+    got = process_chunks(chunks, step, policy=RetryPolicy(max_attempts=2),
+                         counters=counters, task="sum")
+    assert got == clean
+    assert counters.get("Task", "attempts") == len(chunks) + 2
+    assert counters.get("Task", "failed.attempts") == 2
+    assert counters.get("Task", "exhausted") == 0
+    assert step.faults_fired == 2
+
+
+def test_retry_exhaustion_surfaces_last_error():
+    from avenir_tpu.utils.metrics import Counters
+    from avenir_tpu.utils.retry import (FaultInjector, InjectedFault, RetryPolicy,
+                                        TaskExhaustedError, process_chunks)
+
+    chunks = [np.ones(3), np.ones(3)]
+    step = FaultInjector(lambda c: float(c.sum()), fail_on=[2, 3])  # persistent
+    counters = Counters()
+    with pytest.raises(TaskExhaustedError) as ei:
+        process_chunks(chunks, step, policy=RetryPolicy(max_attempts=2),
+                       counters=counters)
+    assert isinstance(ei.value.last, InjectedFault)
+    assert counters.get("Task", "exhausted") == 1
+
+
+def test_retry_policy_honors_reference_property_name():
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.utils.retry import RetryPolicy
+
+    pol = RetryPolicy.from_conf(JobConfig({"mapred.map.max.attempts": "4"}))
+    assert pol.max_attempts == 4
+    # framework alias wins when both present
+    pol2 = RetryPolicy.from_conf(JobConfig(
+        {"mapred.map.max.attempts": "4", "task.max.attempts": "3"}))
+    assert pol2.max_attempts == 3
+    assert RetryPolicy.from_conf(JobConfig({})).max_attempts == 2
+
+
+def test_non_retryable_error_propagates_immediately():
+    from avenir_tpu.utils.retry import RetryPolicy, run_with_retry
+
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("schema error")
+
+    with pytest.raises(ValueError):
+        run_with_retry(boom, policy=RetryPolicy(max_attempts=3,
+                                                retryable=(OSError,)))
+    assert calls["n"] == 1
+
+
+def test_heartbeat_monitor_detects_stall():
+    from avenir_tpu.utils.retry import HeartbeatMonitor
+
+    t = {"now": 100.0}
+    mon = HeartbeatMonitor(timeout_s=5.0, clock=lambda: t["now"])
+    assert not mon.stalled()
+    t["now"] = 104.0
+    mon.beat()
+    t["now"] = 108.0
+    assert not mon.stalled()          # beat at 104, within 5s
+    t["now"] = 109.5
+    assert mon.stalled()
+    assert mon.beats == 1
+
+
+def test_supervisor_restarts_from_checkpoint(rng):
+    # crash the serving loop mid-stream: the supervisor must restore learner
+    # state from its checkpoint (the capability Storm lacked — bolt state
+    # died with the worker) and finish converged; an event whose crash lands
+    # before dequeue is retried naturally (it never left the queue), while
+    # one lost after dequeue is dropped per replay.failed.message=false
+    from avenir_tpu.models import online_rl as orl
+    from avenir_tpu.pipeline import streaming as st
+    from avenir_tpu.utils.retry import InjectedFault
+
+    ctr = {"page1": (30, 12), "page3": (80, 10)}
+    events, rewards, actions = st.InProcQueue(), st.InProcQueue(), st.InProcQueue()
+    total = 300
+    crash_at = 150
+
+    built = []
+
+    def factory():
+        learner = orl.create_learner(
+            "sampsonSampler", list(ctr), {"min.reward.distr.sample": 10}, seed=5)
+        srv = st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(events), st.QueueRewardReader(rewards),
+            st.QueueActionWriter(actions))
+        if not built:                  # first incarnation crashes once
+            orig = srv.process_one
+            state = {"n": 0}
+
+            def flaky():
+                state["n"] += 1
+                if state["n"] == crash_at:
+                    raise InjectedFault("worker died")
+                return orig()
+
+            srv.process_one = flaky
+        built.append(srv)
+        return srv
+
+    sup = st.ServerSupervisor(factory, checkpoint_interval=32, max_restarts=2)
+    picks = {p: 0 for p in ctr}
+    for round_num in range(1, total + 1):
+        events.push(f"ev{round_num},{round_num}")
+        done = sup.run(max_events=1)
+        if done == 0:
+            continue                   # queue drained (never under this schedule)
+        _, page = actions.pop().split(",")
+        rewards.push(f"{page},{max(rng.normal(*ctr[page]), 0.0)}")
+        if round_num > total // 2:
+            picks[page] += 1
+    assert sup.restarts == 1
+    assert len(built) == 2
+    # the crash hit before dequeue, so the event was retried, not lost
+    assert sup.events_processed == total
+    # restored learner kept pre-crash rewards (run() checkpoints at the end
+    # of each incarnation, so the restore blob was taken one event back)
+    learner2 = built[1].learner
+    assert sum(s.count for s in learner2.stats.values()) > 100
+    assert max(picks, key=picks.get) == "page3", picks
+
+
+def test_supervisor_crash_loop_raises():
+    from avenir_tpu.models import online_rl as orl
+    from avenir_tpu.pipeline import streaming as st
+    from avenir_tpu.utils.retry import InjectedFault
+
+    events = st.InProcQueue()
+    for i in range(10):
+        events.push(f"ev{i},{i}")
+
+    def factory():
+        learner = orl.create_learner("randomGreedy", ["a", "b"], {}, seed=1)
+        srv = st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(events),
+            st.QueueRewardReader(st.InProcQueue()),
+            st.QueueActionWriter(st.InProcQueue()))
+        def always_dead():
+            raise InjectedFault("persistent")
+        srv.process_one = always_dead
+        return srv
+
+    sup = st.ServerSupervisor(factory, max_restarts=3)
+    with pytest.raises(InjectedFault):
+        sup.run()
+    assert sup.restarts == 4           # 3 allowed restarts + the fatal one
+
+
+def test_supervisor_interval_checkpoint_within_single_run():
+    # one long run() over pre-queued events: the interval checkpointer (the
+    # path production run(max_events=None) relies on) must be what the
+    # restored server resumes from — not the per-run final checkpoint
+    from avenir_tpu.models import online_rl as orl
+    from avenir_tpu.pipeline import streaming as st
+    from avenir_tpu.utils.retry import InjectedFault
+
+    events, rewards, actions = st.InProcQueue(), st.InProcQueue(), st.InProcQueue()
+    for i in range(1, 101):
+        events.push(f"ev{i},{i}")
+        rewards.push(f"a,{float(i)}")      # one reward drained per event? no:
+    # QueueRewardReader drains everything pending at the first event, which
+    # makes learner state advance deterministically per checkpoint anyway —
+    # what matters below is WHICH blob the restore used.
+
+    blobs = []
+    restored = []
+    built = []
+
+    def factory():
+        learner = orl.create_learner("randomGreedy", ["a", "b"], {}, seed=3)
+        srv = st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(events), st.QueueRewardReader(rewards),
+            st.QueueActionWriter(actions))
+        orig_ckpt = srv.checkpoint
+        srv.checkpoint = lambda: blobs.append(orig_ckpt()) or blobs[-1]
+        orig_restore = srv.restore
+        srv.restore = lambda blob: restored.append(blob) or orig_restore(blob)
+        if not built:
+            orig_po = srv.process_one
+            n = {"v": 0}
+
+            def flaky():
+                n["v"] += 1
+                if n["v"] == 70:
+                    raise InjectedFault("mid-run crash")
+                return orig_po()
+
+            srv.process_one = flaky
+        built.append(srv)
+        return srv
+
+    sup = st.ServerSupervisor(factory, checkpoint_interval=32, max_restarts=2)
+    done = sup.run()                       # single call, crash at event 70
+    assert done == 100
+    assert sup.restarts == 1
+    # first incarnation checkpointed at events 32 and 64 only; the restore
+    # must have used the event-64 interval blob
+    assert restored == [blobs[1]]
+    assert len(built) == 2
+
+
+def test_supervisor_restart_budget_resets_after_stable_progress():
+    # sporadic transient faults over a long-lived loop: more total crashes
+    # than max_restarts, but each separated by sustained progress — the
+    # supervisor must keep serving (no false crash-loop)
+    from avenir_tpu.models import online_rl as orl
+    from avenir_tpu.pipeline import streaming as st
+    from avenir_tpu.utils.retry import InjectedFault
+
+    events = st.InProcQueue()
+    total = 400
+    for i in range(1, total + 1):
+        events.push(f"ev{i},{i}")
+    crash_on = {50, 150, 250, 350}         # 4 transient faults, budget is 2
+
+    calls = {"n": 0}
+
+    def factory():
+        learner = orl.create_learner("randomGreedy", ["a", "b"], {}, seed=9)
+        srv = st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(events),
+            st.QueueRewardReader(st.InProcQueue()),
+            st.QueueActionWriter(st.InProcQueue()))
+        orig = srv.process_one
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] in crash_on:
+                raise InjectedFault("sporadic")
+            return orig()
+
+        srv.process_one = flaky
+        return srv
+
+    sup = st.ServerSupervisor(factory, checkpoint_interval=32, max_restarts=2,
+                              restart_reset_after=50)
+    assert sup.run() == total              # survives all four
+    assert sup.restarts <= 2               # budget refilled between faults
+
+
+def test_streaming_train_fails_fast_on_incomplete_schema(tmp_path):
+    # ConfigError is non-retryable: exactly one attempt, error surfaced
+    # directly rather than wrapped in TaskExhaustedError
+    import json as js
+    from avenir_tpu.core.config import ConfigError, JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+    from avenir_tpu.jobs import get_job
+
+    schema = js.loads(js.dumps(CHURN_SCHEMA_JSON))
+    for f in schema["fields"]:
+        f.pop("cardinality", None)         # open vocabulary
+    write_csv(str(tmp_path / "train.csv"), generate_churn(500, seed=1))
+    (tmp_path / "open.json").write_text(js.dumps(schema))
+    conf = JobConfig({"feature.schema.file.path": str(tmp_path / "open.json"),
+                      "stream.chunk.rows": "100"})
+    with pytest.raises(ConfigError):
+        get_job("BayesianDistribution").run(conf, str(tmp_path / "train.csv"),
+                                            str(tmp_path / "model"))
+
+
+def test_streaming_train_retries_transient_read_fault(tmp_path, monkeypatch):
+    # the retried task re-opens and re-seeks the file, so a transient I/O
+    # fault during the chunk read is absorbed (the Hadoop input-split analog)
+    import builtins
+    import json as js
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    write_csv(str(tmp_path / "train.csv"), generate_churn(900, seed=2))
+    (tmp_path / "churn.json").write_text(js.dumps(CHURN_SCHEMA_JSON))
+
+    real_open = builtins.open
+    state = {"rb_opens": 0}
+
+    def flaky_open(path, mode="r", *a, **kw):
+        if str(path).endswith("train.csv") and mode == "rb":
+            state["rb_opens"] += 1
+            if state["rb_opens"] == 2:     # second chunk's read dies once
+                raise OSError("transient storage fault")
+        return real_open(path, mode, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    conf = JobConfig({"feature.schema.file.path": str(tmp_path / "churn.json"),
+                      "stream.chunk.rows": "300"})
+    c = get_job("BayesianDistribution").run(conf, str(tmp_path / "train.csv"),
+                                            str(tmp_path / "model"))
+    assert c.get("Records", "Processed") == 900
+    assert c.get("Task", "failed.attempts") == 1
+    assert c.get("Task", "exhausted") == 0
+    assert read_lines(str(tmp_path / "model"))

@@ -360,3 +360,37 @@ def test_markov_jobs_ragged_sequences(tmp_path):
     decoded = read_lines(str(tmp_path / "decoded"))
     assert decoded[0].startswith("u1,1,") and decoded[1].startswith("u2,2,")
     assert decoded[0].count(",") == 4            # 2 id fields + 3 states
+
+
+def test_bayesian_streaming_train_matches_whole_and_retries(churn_env, monkeypatch):
+    # stream.chunk.rows gates the chunked read+encode train path: the model
+    # file must be byte-identical to the whole-input path, and an injected
+    # transient encode fault must be absorbed by the task-retry policy
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.utils.retry import InjectedFault
+
+    root, conf = churn_env
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(root / "model_whole"))
+    sconf = JobConfig(dict(conf.props))
+    sconf.set("stream.chunk.rows", "300")
+
+    orig = DatasetEncoder.transform
+    state = {"n": 0}
+
+    def flaky(self, rows, with_labels=True):
+        state["n"] += 1
+        if state["n"] == 3:            # one transient fault mid-stream
+            raise InjectedFault("encode worker died")
+        return orig(self, rows, with_labels=with_labels)
+
+    monkeypatch.setattr(DatasetEncoder, "transform", flaky)
+    c = get_job("BayesianDistribution").run(sconf, str(root / "train.csv"),
+                                            str(root / "model_stream"))
+    assert read_lines(str(root / "model_stream")) == \
+        read_lines(str(root / "model_whole"))
+    assert c.get("Records", "Processed") == 1600
+    assert c.get("Task", "failed.attempts") == 1
+    # ceil(1600/300)=6 chunk tasks + 1 EOF-probe task + 1 retry
+    assert c.get("Task", "attempts") == 6 + 1 + 1
+    assert c.get("Task", "exhausted") == 0
