@@ -14,6 +14,7 @@ from avenir_tpu.core.config import JobConfig
 from avenir_tpu.jobs.base import Job, write_output
 from avenir_tpu.models import fisher as mfisher
 from avenir_tpu.models import logistic as mlr
+from avenir_tpu.utils.locking import FileLock, atomic_write
 from avenir_tpu.utils.metrics import Counters
 
 
@@ -38,25 +39,33 @@ class LogisticRegressionJob(Job):
         y = np.asarray(ds.labels, np.float32)
         coeff_path = conf.get("coeff.file.path") or os.path.join(
             output_path, "coefficients.txt")
-        resume = None
-        if os.path.exists(coeff_path):
-            with open(coeff_path) as fh:
-                lines = [ln for ln in fh if ln.strip()]
-            if lines:
-                resume = mlr.LogisticRegressionModel.from_history_lines(
-                    lines, delim=conf.field_delim)
-        est = mlr.LogisticRegression(
-            learning_rate=conf.get_float("learning.rate", 0.5),
-            max_iterations=conf.get_int("iteration.limit", 200),
-            convergence=conf.get("convergence.criteria", "average"),
-            threshold_pct=conf.get_float("convergence.threshold", 0.5),
-            l2=conf.get_float("l2.weight", 0.0),
-        )
-        model = est.fit(x, y, resume_from=resume)
-        hist = model.history_lines(delim=conf.field_delim)
+        # the coefficient-history rewrite is the reference's one cross-task
+        # mutable-state hazard (LogisticRegressionJob.java:238-255, safe
+        # there only via num.reducer=1): hold an exclusive lock for the
+        # whole read-resume-train-rewrite cycle so a concurrent run is
+        # detected (LockHeldError) instead of silently interleaving, and
+        # replace the file atomically so readers never see a torn history
         os.makedirs(os.path.dirname(coeff_path) or ".", exist_ok=True)
-        with open(coeff_path, "w") as fh:
-            fh.write("\n".join(hist) + "\n")
+        with FileLock(coeff_path,
+                      timeout_s=conf.get_float("coeff.lock.timeout.sec", 10.0)):
+            resume = None
+            if os.path.exists(coeff_path):
+                with open(coeff_path) as fh:
+                    lines = [ln for ln in fh if ln.strip()]
+                if lines:
+                    resume = mlr.LogisticRegressionModel.from_history_lines(
+                        lines, delim=conf.field_delim)
+            est = mlr.LogisticRegression(
+                learning_rate=conf.get_float("learning.rate", 0.5),
+                max_iterations=conf.get_int("iteration.limit", 200),
+                convergence=conf.get("convergence.criteria", "average"),
+                threshold_pct=conf.get_float("convergence.threshold", 0.5),
+                l2=conf.get_float("l2.weight", 0.0),
+            )
+            model = est.fit(x, y, resume_from=resume)
+            hist = model.history_lines(delim=conf.field_delim)
+            with atomic_write(coeff_path) as fh:
+                fh.write("\n".join(hist) + "\n")
         status = "converged" if model.converged else "iterationLimit"
         write_output(output_path, hist + [f"status{conf.field_delim}{status}"])
         counters.set("Records", "Processed", ds.num_rows)

@@ -40,10 +40,32 @@ def _build() -> Optional[ctypes.CDLL]:
     global _build_error
     if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
         return ctypes.CDLL(_LIB)
+    # two processes importing concurrently must not both write the .so:
+    # serialize builders on a lock, compile to a temp path, publish with an
+    # atomic rename, and re-check under the lock (the loser just loads)
+    from avenir_tpu.utils.locking import FileLock, LockHeldError
+
     try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17", "-o", _LIB, _SRC],
-            check=True, capture_output=True, text=True, timeout=120)
+        with FileLock(_LIB, timeout_s=150.0):
+            if os.path.exists(_LIB) and \
+                    os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+                return ctypes.CDLL(_LIB)
+            tmp = _LIB + ".build"
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                     "-std=c++17", "-o", tmp, _SRC],
+                    check=True, capture_output=True, text=True, timeout=120)
+                os.replace(tmp, _LIB)
+            except BaseException:
+                try:
+                    os.unlink(tmp)     # no partial artifact on failure
+                except OSError:
+                    pass
+                raise
+    except LockHeldError as e:
+        _build_error = str(e)
+        return None
     except (OSError, subprocess.SubprocessError) as e:
         _build_error = getattr(e, "stderr", None) or str(e)
         return None

@@ -1,0 +1,124 @@
+"""Race protection for cross-process mutable files — locks + atomic writes.
+
+The reference has exactly one cross-task mutable-state hazard: the LR
+coefficient-history rewrite (regress/LogisticRegressionJob.java:238-255,
+delete + rewrite), safe there only because ``num.reducer=1`` pins a single
+writer (SURVEY.md §5 "race detection"). Everything else inherits MR's
+share-nothing model. This framework runs in ordinary processes where
+nothing pins a single writer, so the equivalent files (LR history, the
+compiled native library) get explicit protection:
+
+- :class:`FileLock` — advisory ``flock`` on a sidecar ``<path>.lock``;
+  contention within ``timeout_s`` raises :class:`LockHeldError`, which
+  *detects* a concurrent writer instead of silently interleaving (the
+  race-detection capability the reference lacks).
+- :func:`atomic_write` — write to a same-directory temp file then
+  ``os.replace``, so readers never observe a torn file and a crash
+  mid-write leaves the previous version intact (complements
+  utils/checkpoint.py's temp-dir + rename discipline).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import stat
+import tempfile
+import time
+from typing import IO, Iterator, Optional
+
+try:
+    import fcntl
+except ImportError:                      # non-POSIX: degrade to lockless
+    fcntl = None  # type: ignore[assignment]
+
+
+class LockHeldError(RuntimeError):
+    """Another process holds the lock — a concurrent writer was detected."""
+
+    def __init__(self, path: str, timeout_s: float):
+        super().__init__(
+            f"lock {path!r} held by another process (waited {timeout_s}s); "
+            "refusing to interleave writes")
+        self.path = path
+
+
+class FileLock:
+    """Advisory exclusive lock on ``<target>.lock``.
+
+    ``timeout_s=0`` means try-once (pure detection); positive values poll
+    until acquired or :class:`LockHeldError`. Reentrant use in one process
+    is not supported — the point is cross-process exclusion.
+    """
+
+    def __init__(self, target: str, timeout_s: float = 0.0,
+                 poll_s: float = 0.05):
+        self.lock_path = target + ".lock"
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+        self._fh: Optional[IO] = None
+
+    def acquire(self) -> "FileLock":
+        if fcntl is None:
+            return self
+        deadline = time.monotonic() + self.timeout_s
+        fh = open(self.lock_path, "a+")
+        while True:
+            try:
+                fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fh = fh
+                return self
+            except OSError as e:
+                # only genuine contention polls/raises LockHeldError; a
+                # filesystem that cannot flock (ENOLCK/EOPNOTSUPP on some
+                # NFS/FUSE mounts) must surface its real error, not a
+                # phantom concurrent writer
+                if e.errno not in (errno.EWOULDBLOCK, errno.EAGAIN,
+                                   errno.EACCES):
+                    fh.close()
+                    raise
+                if time.monotonic() >= deadline:
+                    fh.close()
+                    raise LockHeldError(self.lock_path, self.timeout_s) from None
+                time.sleep(self.poll_s)
+
+    def release(self) -> None:
+        if self._fh is not None:
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "FileLock":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "w") -> Iterator[IO]:
+    """Write via a same-directory temp file + ``os.replace`` — readers see
+    either the old or the new complete file, never a torn one."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp.")
+    try:
+        # mkstemp creates 0600; carry over the target's existing mode (or
+        # umask-default for new files) so the rewrite doesn't silently
+        # tighten permissions on a file other readers already use
+        try:
+            os.chmod(tmp, stat.S_IMODE(os.stat(path).st_mode))
+        except FileNotFoundError:
+            umask = os.umask(0)
+            os.umask(umask)
+            os.chmod(tmp, 0o666 & ~umask)
+        with os.fdopen(fd, mode) as fh:
+            yield fh
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise

@@ -535,3 +535,159 @@ def test_streaming_train_retries_transient_read_fault(tmp_path, monkeypatch):
     assert c.get("Task", "failed.attempts") == 1
     assert c.get("Task", "exhausted") == 0
     assert read_lines(str(tmp_path / "model"))
+
+
+# ---------------------------------------------------------------------------
+# race protection (utils/locking.py)
+# ---------------------------------------------------------------------------
+
+def test_filelock_detects_concurrent_writer(tmp_path):
+    import multiprocessing as mp
+    from avenir_tpu.utils.locking import FileLock, LockHeldError
+
+    target = str(tmp_path / "state.txt")
+
+    def hold(path, started, release):
+        from avenir_tpu.utils.locking import FileLock
+        with FileLock(path):
+            started.set()
+            release.wait(10)
+
+    ctx = mp.get_context("fork")
+    started, release = ctx.Event(), ctx.Event()
+    p = ctx.Process(target=hold, args=(target, started, release))
+    p.start()
+    try:
+        assert started.wait(10)
+        with pytest.raises(LockHeldError):
+            FileLock(target, timeout_s=0.2).acquire()
+    finally:
+        release.set()
+        p.join(10)
+    # released: acquisition now succeeds
+    with FileLock(target, timeout_s=1.0):
+        pass
+
+
+def test_atomic_write_never_tears(tmp_path):
+    from avenir_tpu.utils.locking import atomic_write
+
+    path = str(tmp_path / "hist.txt")
+    with atomic_write(path) as fh:
+        fh.write("v1\n")
+    assert open(path).read() == "v1\n"
+
+    # a crash mid-write must leave the previous version intact
+    with pytest.raises(RuntimeError):
+        with atomic_write(path) as fh:
+            fh.write("v2-partial")
+            raise RuntimeError("crash mid-write")
+    assert open(path).read() == "v1\n"
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+
+
+def test_lr_job_detects_concurrent_history_writer(tmp_path):
+    # the reference's one race hazard (coefficient-file rewrite) must be
+    # detected, not silently interleaved, when two runs share coeff.file.path
+    import json as js
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.elearn import ELEARN_SCHEMA_JSON, generate_elearn
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.utils.locking import FileLock, LockHeldError
+
+    rows = generate_elearn(400, seed=4)
+    write_csv(str(tmp_path / "train.csv"), rows)
+    (tmp_path / "elearn.json").write_text(js.dumps(ELEARN_SCHEMA_JSON))
+    coeff = str(tmp_path / "coeff.txt")
+    conf = JobConfig({"feature.schema.file.path": str(tmp_path / "elearn.json"),
+                      "coeff.file.path": coeff,
+                      "iteration.limit": "5",
+                      "coeff.lock.timeout.sec": "0.2"})
+    with FileLock(coeff):                  # simulate a concurrent run
+        with pytest.raises(LockHeldError):
+            get_job("LogisticRegressionJob").run(
+                conf, str(tmp_path / "train.csv"), str(tmp_path / "out"))
+    # lock released: the run proceeds and leaves a complete history
+    get_job("LogisticRegressionJob").run(
+        conf, str(tmp_path / "train.csv"), str(tmp_path / "out"))
+    assert open(coeff).read().strip()
+
+
+def test_concurrent_native_builds_single_winner(tmp_path):
+    # two processes racing to compile the .so must serialize on the build
+    # lock and both end up loading a valid library
+    import multiprocessing as mp
+    import shutil
+    from avenir_tpu.runtime import native as nat
+
+    src_dir = tmp_path / "native"
+    src_dir.mkdir()
+    shutil.copy(nat._SRC, src_dir / "csv_encode.cpp")
+
+    def build_one(srcdir, q):
+        from avenir_tpu.runtime import native
+        native._SRC = os.path.join(srcdir, "csv_encode.cpp")
+        native._LIB = os.path.join(srcdir, "libavenir_native.so")
+        native._lib = None
+        native._build_error = None
+        lib = native._get_lib()
+        q.put(lib is not None and native.build_error() is None)
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    ps = [ctx.Process(target=build_one, args=(str(src_dir), q)) for _ in range(2)]
+    for p in ps:
+        p.start()
+    results = [q.get(timeout=300) for _ in ps]
+    for p in ps:
+        p.join(10)
+    assert results == [True, True]
+    assert os.path.exists(src_dir / "libavenir_native.so")
+    assert not os.path.exists(src_dir / "libavenir_native.so.build")
+
+
+def test_atomic_write_preserves_permissions(tmp_path):
+    from avenir_tpu.utils.locking import atomic_write
+
+    path = str(tmp_path / "hist.txt")
+    open(path, "w").write("v0\n")
+    os.chmod(path, 0o644)
+    with atomic_write(path) as fh:
+        fh.write("v1\n")
+    assert oct(os.stat(path).st_mode & 0o777) == oct(0o644)
+    # fresh files get umask-default, not mkstemp's 0600
+    path2 = str(tmp_path / "new.txt")
+    with atomic_write(path2) as fh:
+        fh.write("x\n")
+    umask = os.umask(0)
+    os.umask(umask)
+    assert (os.stat(path2).st_mode & 0o777) == (0o666 & ~umask)
+
+
+def test_failed_native_build_leaves_no_partial_artifact(tmp_path):
+    import multiprocessing as mp
+
+    src_dir = tmp_path / "native"
+    src_dir.mkdir()
+    (src_dir / "csv_encode.cpp").write_text("this is not C++\n")
+
+    def build_one(srcdir, q):
+        from avenir_tpu.runtime import native
+        native._SRC = os.path.join(srcdir, "csv_encode.cpp")
+        native._LIB = os.path.join(srcdir, "libavenir_native.so")
+        native._lib = None
+        native._build_error = None
+        lib = native._get_lib()
+        q.put((lib is None, native.build_error() is not None))
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=build_one, args=(str(src_dir), q))
+    p.start()
+    failed, has_error = q.get(timeout=300)
+    p.join(10)
+    assert failed and has_error
+    assert sorted(os.listdir(src_dir)) == ["csv_encode.cpp"] or \
+        sorted(n for n in os.listdir(src_dir) if not n.endswith(".lock")) == \
+        ["csv_encode.cpp"]
