@@ -20,12 +20,24 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from avenir_tpu.ops.agg import one_hot as _onehot
+from avenir_tpu.ops.agg import _check_chunk, one_hot as _onehot
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _shard_map_norep(step, mesh, in_specs, out_specs):
+    """shard_map with the replicated-output check disabled — the kwarg was
+    renamed check_rep → check_vma across jax versions, so probe once here
+    instead of copy-pasting the shim at every call site."""
+    try:
+        return _shard_map(step, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover
+        return _shard_map(step, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
 
 
 def sharded_nb_fit_step(mesh: Mesh, num_classes: int, num_bins: int, num_cont: int):
@@ -122,12 +134,7 @@ def sharded_knn_topk(mesh: Mesh, k: int, num_bins: int,
     # after the all_gather), but shard_map cannot infer that statically —
     # disable the replication check (kwarg renamed across jax versions)
     in_specs = (P(), P(), P(data_axis, None), P(data_axis, None), P(), P(), P())
-    try:
-        wrapped = _shard_map(step, mesh=mesh, in_specs=in_specs,
-                             out_specs=(P(), P()), check_vma=False)
-    except TypeError:  # pragma: no cover
-        wrapped = _shard_map(step, mesh=mesh, in_specs=in_specs,
-                             out_specs=(P(), P()), check_rep=False)
+    wrapped = _shard_map_norep(step, mesh, in_specs, (P(), P()))
     return jax.jit(wrapped)
 
 
@@ -152,4 +159,49 @@ def sharded_lr_step(mesh: Mesh, data_axis: str = "data"):
         in_specs=(P(), P(data_axis, None), P(data_axis), P(), P(), P()),
         out_specs=P(),
     )
+    return jax.jit(wrapped)
+
+
+def sharded_mi_step(mesh: Mesh, num_classes: int, num_bins: int,
+                    data_axis: str = "data", model_axis: str = "model"):
+    """2-D sharded mutual-information count step — the high-cardinality
+    joint-distribution layout (SURVEY.md §7 "hard parts": feature-pair×class
+    one-hots are O(F²·V²·C)).
+
+    Batch shards over ``data`` (the reference's record sharding across MI
+    mappers, explore/MutualInformation.java:136-214); the [P, B, B, C]
+    pair-class tensor shards its *pair axis* over ``model`` (the reference's
+    key-space partitioning of (distrType, ordinals…) shuffle keys), so each
+    device holds only P/model_parallel of the largest tensor while the
+    ``psum`` over ``data`` plays the combiner+shuffle. The [F, B, C]
+    feature-class tensor and [C] class counts are cheap and come back
+    replicated.
+
+    Returns a jitted fn(codes [N, F] data-sharded, labels [N] data-sharded,
+    ci [P] model-sharded, cj [P] model-sharded) →
+    (pair_class [P, B, B, C] pair-axis model-sharded,
+     feature_class [F, B, C] replicated, class_counts [C] replicated).
+    """
+
+    def step(codes, labels, ci, cj):
+        _check_chunk(codes)            # per-shard f32 exact-accumulation cap
+        oh_c = _onehot(labels, num_classes)            # [n_loc, C]
+        # local slice of the pair list: gather both columns per local pair
+        oh_i = _onehot(jnp.take(codes, ci, axis=1), num_bins)  # [n_loc, P_loc, B]
+        oh_j = _onehot(jnp.take(codes, cj, axis=1), num_bins)
+        pabc = jnp.einsum("npa,npb,nc->pabc", oh_i, oh_j, oh_c,
+                          precision="highest").astype(jnp.int32)
+        fbc = jnp.einsum("nfb,nc->fbc", _onehot(codes, num_bins), oh_c,
+                         precision="highest").astype(jnp.int32)
+        cc = jnp.sum(oh_c, axis=0).astype(jnp.int32)
+        return (jax.lax.psum(pabc, data_axis),
+                jax.lax.psum(fbc, data_axis),
+                jax.lax.psum(cc, data_axis))
+
+    in_specs = (P(data_axis, None), P(data_axis),
+                P(model_axis), P(model_axis))
+    # fbc/cc are replicated across model by construction but shard_map
+    # cannot infer it
+    wrapped = _shard_map_norep(step, mesh, in_specs,
+                               (P(model_axis, None, None, None), P(), P()))
     return jax.jit(wrapped)

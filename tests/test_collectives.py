@@ -162,3 +162,38 @@ def test_process_local_batch_single_process():
     out = pmesh.process_local_batch(m, arr)
     assert out.shape[0] % m.shape["data"] == 0
     np.testing.assert_array_equal(np.asarray(out)[:10], arr)
+
+
+def test_sharded_mi_step_matches_local():
+    # 2-D mesh: batch over data, pair axis of the [P,B,B,C] tensor over
+    # model — each device holds 1/model_par of the largest MI tensor
+    import numpy as np
+    from avenir_tpu.ops import agg
+    from avenir_tpu.parallel import collectives, mesh as pmesh
+
+    rng = np.random.default_rng(21)
+    c, b, f = 2, 5, 6
+    pairs = np.array([(i, j) for i in range(f) for j in range(i + 1, f)],
+                     np.int32)                     # P = 15, not divisible by 2
+    # pad the pair list to a multiple of the model axis with a sentinel pair
+    # (0,0): its counts land in a discarded tail slot
+    m = pmesh.make_mesh(("data", "model"), shape=(4, 2))
+    pmodel = m.shape["model"]
+    P = len(pairs)
+    pad = (-P) % pmodel
+    pairs_padded = np.concatenate([pairs, np.zeros((pad, 2), np.int32)])
+
+    n = 64
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+
+    step = collectives.sharded_mi_step(m, c, b)
+    pabc, fbc, cc = step(codes, labels, pairs_padded[:, 0], pairs_padded[:, 1])
+    pabc = np.asarray(pabc)[:P]
+
+    ref_pabc = np.asarray(agg.pair_class_counts(
+        codes[:, pairs[:, 0]], codes[:, pairs[:, 1]], labels, c, b))
+    ref_fbc = np.asarray(agg.feature_class_counts(codes, labels, c, b))
+    np.testing.assert_array_equal(pabc, ref_pabc)
+    np.testing.assert_array_equal(np.asarray(fbc), ref_fbc)
+    np.testing.assert_array_equal(np.asarray(cc), np.bincount(labels, minlength=c))
