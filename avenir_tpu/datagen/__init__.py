@@ -7,5 +7,7 @@ algorithm is expected to recover, which is how the reference is validated
 """
 
 from avenir_tpu.datagen.churn import generate_churn, CHURN_SCHEMA_JSON
+from avenir_tpu.datagen.disease import generate_disease, DISEASE_SCHEMA_JSON
 
-__all__ = ["generate_churn", "CHURN_SCHEMA_JSON"]
+__all__ = ["generate_churn", "CHURN_SCHEMA_JSON",
+           "generate_disease", "DISEASE_SCHEMA_JSON"]

@@ -73,6 +73,9 @@ class ClassPartitionGenerator(Job):
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]
         all_splits = dtree.generate_candidate_splits(ds, p["max_split"], is_cat)
+        # honor the reference's externally supplied parent info content (from
+        # the at.root bootstrap); default = derive from the node itself
+        parent_info = conf.get_float("parent.info")
         labels = jnp.asarray(ds.labels)
         node_ids = jnp.zeros(ds.num_rows, jnp.int32)
         lines: List[str] = []
@@ -91,7 +94,8 @@ class ClassPartitionGenerator(Job):
                 hist = dtree.split_node_histograms(
                     jnp.asarray(seg_codes), node_ids, labels,
                     gmax, 1, ds.num_classes)
-                scores = np.asarray(dtree.split_scores(hist, p["algorithm"]))
+                scores = np.asarray(dtree.split_scores(
+                    hist, p["algorithm"], parent_info=parent_info))
                 hist_np = np.asarray(hist) if out_distr else None
                 for si, sp in enumerate(chunk):
                     row = [str(ordinal), sp.key, f"{float(scores[si, 0]):.6f}"]

@@ -175,11 +175,16 @@ def split_node_histograms(
     return jnp.einsum("nsg,nk,nc->sgkc", oh_s, oh_k, oh_c, precision="highest")
 
 
-def split_scores(hist: jax.Array, algorithm: str) -> jax.Array:
+def split_scores(hist: jax.Array, algorithm: str,
+                 parent_info: Optional[float] = None) -> jax.Array:
     """hist [S, G, K, C] → score [S, K]; higher is better for every algorithm.
 
     entropy/giniIndex → gain ratio: (parent impurity − weighted child
     impurity) / split info content (AttributeSplitStat.java:85-93,153-218).
+    ``parent_info``, when given, substitutes the reference's externally
+    supplied ``parent.info`` property (ClassPartitionGenerator.java:510,533
+    — produced by the ``at.root`` bootstrap job) for the parent impurity
+    computed from the node's own histogram (the self-contained default).
     hellingerDistance → distance between the per-class segment distributions
     (binary class, :228-284). classConfidenceRatio → entropy of the
     normalized per-segment class-confidence ratios (:291-339); lower entropy
@@ -194,7 +199,9 @@ def split_scores(hist: jax.Array, algorithm: str) -> jax.Array:
         imp = info.entropy_from_counts if algorithm == "entropy" else info.gini_from_counts
         child = imp(h, axis=-1)                           # [S, G, K]
         weighted = jnp.sum(w * child, axis=1)             # [S, K]
-        gain = imp(parent, axis=-1) - weighted
+        p_imp = (imp(parent, axis=-1) if parent_info is None
+                 else jnp.float32(parent_info))
+        gain = p_imp - weighted
         split_info = info.entropy(jnp.swapaxes(w, 1, 2), axis=-1)   # [S, K]
         return gain / jnp.maximum(split_info, 1e-6)
     if algorithm == "hellingerDistance":

@@ -288,3 +288,48 @@ def test_class_partition_generator_at_root(tmp_path):
     p = np.mean(labels == "Y")
     expected = -(p * np.log(p) + (1 - p) * np.log(1 - p))
     np.testing.assert_allclose(stat, expected, rtol=1e-4)
+
+
+def test_disease_rule_mining_recovers_age_driver(tmp_path):
+    # the disease rule-mining runbook: candidate-split scoring over the
+    # planted disease.rb mechanism must rank an age split highest (age has
+    # the strongest multiplier ladder), with the reference's two-phase
+    # at.root bootstrap feeding parent.info into the gain ratio
+    import json as js
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.disease import DISEASE_SCHEMA_JSON, generate_disease
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    rows = generate_disease(12000, seed=13)
+    write_csv(str(tmp_path / "patients.csv"), rows)
+    (tmp_path / "patient.json").write_text(js.dumps(DISEASE_SCHEMA_JSON))
+    base = {"feature.schema.file.path": str(tmp_path / "patient.json")}
+
+    # phase 1: dataset-level info content (at.root)
+    get_job("ClassPartitionGenerator").run(
+        JobConfig({**base, "at.root": "true", "split.algorithm": "entropy"}),
+        str(tmp_path / "patients.csv"), str(tmp_path / "root"))
+    parent_info = float(read_lines(str(tmp_path / "root"))[0])
+    assert 0.0 < parent_info <= 1.0
+
+    # phase 2: scored candidate splits with parent.info, as in
+    # disease.properties (the tutorial uses hellinger; entropy exercises
+    # the parent.info path since hellinger ignores it)
+    get_job("ClassPartitionGenerator").run(
+        JobConfig({**base, "split.algorithm": "entropy",
+                   "parent.info": f"{parent_info}", "max.split": "3"}),
+        str(tmp_path / "patients.csv"), str(tmp_path / "splits"))
+    lines = [ln.split(";") for ln in read_lines(str(tmp_path / "splits"))]
+    best = max(lines, key=lambda r: float(r[2]))
+    assert best[0] == "1", f"expected age (ordinal 1) split, got {best}"
+
+    # hellinger ranking agrees on the driver (the tutorial's algorithm)
+    get_job("ClassPartitionGenerator").run(
+        JobConfig({**base, "split.algorithm": "hellingerDistance",
+                   "max.split": "3"}),
+        str(tmp_path / "patients.csv"), str(tmp_path / "hsplits"))
+    hlines = [ln.split(";") for ln in read_lines(str(tmp_path / "hsplits"))]
+    hbest = max(hlines, key=lambda r: float(r[2]))
+    assert hbest[0] == "1", f"expected age split under hellinger, got {hbest}"
