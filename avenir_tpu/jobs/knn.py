@@ -136,6 +136,7 @@ class NearestNeighbor(Job):
             decision_threshold=conf.get_float("decision.threshold"),
             pos_class=conf.get("positive.class.value"),
             cost=cost,
+            search_mode=conf.get("knn.search.mode", "exact"),
         )
         out: List[str] = []
         if regression:

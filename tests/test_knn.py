@@ -161,3 +161,55 @@ def test_pairwise_distance_lines(elearn):
     assert len(lines) == 15
     tid, rid, dist = lines[0].split(",")
     assert tid == "t0" and 0 <= int(dist) <= 1000
+
+
+def test_approx_search_mode_high_recall(rng):
+    # flag-gated approximate search: per-tile lax.approx_min_k + exact
+    # cross-tile merge; recall vs the exact scan must stay high and the
+    # returned distances must be true distances for the returned indices.
+    # NOTE: on this CPU test backend approx_min_k falls back to exact
+    # top-k, so this pins the plumbing (mode dispatch, merge, ordering,
+    # index/distance consistency), not the approximation itself — the real
+    # recall is measured on TPU by benchmarks/knn_qps.py (BASELINE.md:
+    # 0.9988 at 1M refs, k=10)
+    n, m, k = 20_000, 256, 10
+    ds = EncodedDataset(
+        codes=rng.integers(0, 8, size=(n, 4)).astype(np.int32),
+        cont=rng.normal(size=(n, 6)).astype(np.float32),
+        labels=rng.integers(0, 2, size=n).astype(np.int32),
+        ids=None, n_bins=np.full(4, 8, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(4)), cont_ordinals=list(range(4, 10)))
+    test = EncodedDataset(
+        codes=rng.integers(0, 8, size=(m, 4)).astype(np.int32),
+        cont=rng.normal(size=(m, 6)).astype(np.float32),
+        labels=None, ids=None, n_bins=ds.n_bins, class_values=ds.class_values,
+        binned_ordinals=ds.binned_ordinals, cont_ordinals=ds.cont_ordinals)
+    model = knn_mod.fit_knn(ds)
+    d_ex, i_ex = knn_mod.nearest_neighbors(model, test, k=k, ref_tile=4096)
+    d_ap, i_ap = knn_mod.nearest_neighbors(model, test, k=k, ref_tile=4096,
+                                        mode="approx")
+    recall = np.mean([len(set(i_ex[q]) & set(i_ap[q])) / k for q in range(m)])
+    assert recall >= 0.95, recall
+    # distances ascending and consistent with exact distances of same index
+    assert np.all(np.diff(d_ap, axis=1) >= -1e-6)
+    # any overlap position must carry the same distance
+    for q in range(0, m, 37):
+        common = set(i_ex[q]) & set(i_ap[q])
+        ex_map = dict(zip(i_ex[q].tolist(), d_ex[q].tolist()))
+        ap_map = dict(zip(i_ap[q].tolist(), d_ap[q].tolist()))
+        for ix in common:
+            assert abs(ex_map[ix] - ap_map[ix]) < 1e-5
+
+
+def test_unknown_search_mode_raises(rng):
+    ds = EncodedDataset(
+        codes=rng.integers(0, 4, size=(50, 2)).astype(np.int32),
+        cont=np.zeros((50, 0), np.float32),
+        labels=rng.integers(0, 2, size=50).astype(np.int32),
+        ids=None, n_bins=np.full(2, 4, np.int32), class_values=["a", "b"],
+        binned_ordinals=[0, 1], cont_ordinals=[])
+    model = knn_mod.fit_knn(ds)
+    with pytest.raises(ValueError):
+        knn_mod.nearest_neighbors(model, ds, k=3, mode="wat")
+    with pytest.raises(ValueError):
+        knn_mod.KNN(k=3, search_mode="wat")
