@@ -108,6 +108,31 @@ class Job:
 
     # -- shared plumbing -----------------------------------------------------
     @staticmethod
+    def auto_mesh(conf: JobConfig):
+        """Data-parallel mesh over all local devices, or None single-device.
+
+        When more than one accelerator is attached, jobs shard each chunk's
+        batch axis over a 1-D ``data`` mesh and let XLA insert the count
+        all-reduce over ICI — the reference's mapper-fleet + combiner +
+        shuffle, with zero per-job code. ``data.parallel.auto=false``
+        disables it (single-device execution regardless of topology).
+
+        Single-process only: the sharding path places globally-addressed
+        arrays (``device_put_sharded_batch``), so multi-host (DCN) runs —
+        where each process addresses only its local devices — must build
+        their mesh and per-process arrays explicitly
+        (``parallel/mesh.py::{make_hybrid_mesh, process_local_batch}``)."""
+        if not conf.get_bool("data.parallel.auto", True):
+            return None
+        import jax
+
+        if jax.process_count() > 1 or jax.device_count() < 2:
+            return None
+        from avenir_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(("data",))
+
+    @staticmethod
     def load_schema(conf: JobConfig) -> FeatureSchema:
         path = conf.get("feature.schema.file.path")
         if not path:

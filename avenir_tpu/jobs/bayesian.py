@@ -26,7 +26,8 @@ class BayesianDistribution(Job):
         if not conf.get_bool("tabular.input", True):
             self._execute_text(conf, input_path, output_path, counters)
             return
-        nbayes = nb.NaiveBayes(laplace=conf.get_float("laplace.smoothing", 1.0))
+        nbayes = nb.NaiveBayes(laplace=conf.get_float("laplace.smoothing", 1.0),
+                               mesh=self.auto_mesh(conf))
         if conf.get("stream.chunk.rows"):
             # streaming train: chunked read+encode under the task-retry
             # policy, counts accumulated chunk-by-chunk on device (needs a

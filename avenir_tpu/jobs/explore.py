@@ -37,7 +37,8 @@ class MutualInformation(Job):
         schema = self.load_schema(conf)
         _enc, ds, _rows = self.encode_input(conf, input_path)
         names = [schema.field_by_ordinal(o).name for o in ds.binned_ordinals]
-        result = mi.MutualInformation().fit(ds, feature_names=names)
+        result = mi.MutualInformation(mesh=self.auto_mesh(conf)).fit(
+            ds, feature_names=names)
         lines: List[str] = []
         if conf.get_bool("output.mutual.info", True):
             lines.extend(result.to_lines(delim=delim))

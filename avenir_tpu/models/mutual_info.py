@@ -119,8 +119,13 @@ class MutualInformation:
     [P, B, B, C] tensor; pairs are swept in slices and accumulated on host.
     """
 
-    def __init__(self, pair_chunk: int = 256):
+    def __init__(self, pair_chunk: int = 256, mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` with a ``data`` axis —
+        chunks are then batch-sharded over the mesh and XLA inserts the
+        cross-device count reduction (−1 pad rows are count-neutral);
+        integer counts make the result bit-identical to single-device."""
         self.pair_chunk = pair_chunk
+        self.mesh = mesh
 
     def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]],
             feature_names: Optional[Sequence[str]] = None) -> MutualInfoResult:
@@ -135,8 +140,8 @@ class MutualInformation:
                               np.int32).reshape(-1, 2)
         acc = agg.Accumulator()
         for ds in chunks:
-            codes = jnp.asarray(ds.codes)
-            labels = jnp.asarray(ds.labels)
+            from avenir_tpu.parallel.mesh import maybe_shard_batch
+            codes, labels = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             acc.add("class", agg.class_counts(labels, c))
             acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
             for s in range(0, len(pair_index), self.pair_chunk):

@@ -152,8 +152,22 @@ class NaiveBayes:
     :func:`model_to_lines` / :func:`model_from_lines`.
     """
 
-    def __init__(self, laplace: float = 1.0):
+    def __init__(self, laplace: float = 1.0, mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` with a ``data`` axis —
+        each chunk's batch axis is then sharded over the mesh and XLA
+        auto-inserts the cross-device reduction for the count tensors (the
+        reference's combiner+shuffle over ICI). Pad rows use −1 codes/
+        labels, which are count-neutral under one-hot (tests/test_agg.py).
+        Count tensors are integers, so binned/categorical results are
+        bit-identical to single-device; Gaussian moment sums (Σx, Σx²) are
+        float reductions whose cross-device order may differ in the last
+        ulp. Single-process only (see parallel/mesh.py)."""
         self.laplace = laplace
+        self.mesh = mesh
+
+    def _batch(self, *arrays):
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+        return maybe_shard_batch(self.mesh, *arrays)
 
     def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]]) -> NaiveBayesModel:
         chunks = [data] if isinstance(data, EncodedDataset) else data
@@ -164,12 +178,12 @@ class NaiveBayes:
             if ds.labels is None:
                 raise ValueError("fit requires labels (class attribute column)")
             c, b = ds.num_classes, ds.max_bins
-            labels = jnp.asarray(ds.labels)
+            codes, labels, cont = self._batch(ds.codes, ds.labels, ds.cont)
             if ds.num_binned:
-                acc.add("bin_counts", agg.feature_class_counts(jnp.asarray(ds.codes), labels, c, b))
+                acc.add("bin_counts", agg.feature_class_counts(codes, labels, c, b))
             acc.add("class_counts", agg.class_counts(labels, c))
             if ds.num_cont:
-                cnt, s1, s2 = agg.class_moments(jnp.asarray(ds.cont), labels, c)
+                cnt, s1, s2 = agg.class_moments(cont, labels, c)
                 acc.add("cont_count", cnt)
                 acc.add("cont_sum", s1)
                 acc.add("cont_sumsq", s2)

@@ -23,6 +23,7 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -196,3 +197,16 @@ def process_local_batch(mesh: Mesh, array: np.ndarray, data_axis: str = "data"):
         return device_put_sharded_batch(mesh, array, data_axis=data_axis)
     sharding = data_sharding(mesh, array.ndim, data_axis)
     return jax.make_array_from_process_local_data(sharding, array)
+
+
+def maybe_shard_batch(mesh, *arrays, data_axis: str = "data"):
+    """Shard the batch axis over ``mesh`` when it is a real >1-device data
+    mesh, else plain ``jnp.asarray`` — the single dispatch policy shared by
+    every estimator's ``mesh=`` parameter (NaiveBayes, MutualInformation).
+    Single-process only, like :func:`device_put_sharded_batch`; multi-host
+    callers build arrays with ``make_array_from_process_local_data``.
+    Always returns a list matching ``arrays``."""
+    if mesh is not None and mesh.shape.get(data_axis, 1) > 1:
+        out = device_put_sharded_batch(mesh, *arrays, data_axis=data_axis)
+        return out if len(arrays) > 1 else [out]
+    return [None if a is None else jnp.asarray(a) for a in arrays]

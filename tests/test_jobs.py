@@ -394,3 +394,51 @@ def test_bayesian_streaming_train_matches_whole_and_retries(churn_env, monkeypat
     # ceil(1600/300)=6 chunk tasks + 1 EOF-probe task + 1 retry
     assert c.get("Task", "attempts") == 6 + 1 + 1
     assert c.get("Task", "exhausted") == 0
+
+
+def test_auto_mesh_sharded_train_identical_and_disableable(churn_env):
+    # with 8 virtual devices attached, jobs auto-shard each batch over a
+    # data mesh (XLA inserts the count all-reduce); integer counts make the
+    # model file byte-identical to forced single-device execution
+    import jax
+
+    assert jax.device_count() == 8       # conftest virtual mesh
+    root, conf = churn_env
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(root / "model_mesh"))
+    off = JobConfig(dict(conf.props))
+    off.set("data.parallel.auto", "false")
+    get_job("BayesianDistribution").run(off, str(root / "train.csv"),
+                                        str(root / "model_single"))
+    assert read_lines(str(root / "model_mesh")) == \
+        read_lines(str(root / "model_single"))
+    # MI job likewise
+    get_job("MutualInformation").run(conf, str(root / "train.csv"),
+                                     str(root / "mi_mesh"))
+    get_job("MutualInformation").run(off, str(root / "train.csv"),
+                                     str(root / "mi_single"))
+    assert read_lines(str(root / "mi_mesh")) == read_lines(str(root / "mi_single"))
+
+
+def test_auto_mesh_gaussian_moments_agree(elearn_env, tmp_path):
+    # continuous (Gaussian) features: moment sums are float reductions whose
+    # cross-device order may differ in the last ulp — model files must agree
+    # to float tolerance (integer count lines exactly)
+    root, conf = elearn_env
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(tmp_path / "m_mesh"))
+    off = JobConfig(dict(conf.props))
+    off.set("data.parallel.auto", "false")
+    get_job("BayesianDistribution").run(off, str(root / "train.csv"),
+                                        str(tmp_path / "m_single"))
+    a = read_lines(str(tmp_path / "m_mesh"))
+    b = read_lines(str(tmp_path / "m_single"))
+    assert len(a) == len(b)
+    for la, lb in zip(a, b):
+        if la == lb:
+            continue
+        fa, fb = la.split(","), lb.split(",")
+        assert len(fa) == len(fb)
+        for xa, xb in zip(fa, fb):
+            if xa != xb:
+                np.testing.assert_allclose(float(xa), float(xb), rtol=1e-5)
