@@ -175,8 +175,10 @@ class NumericalAttrStats(Job):
         else:
             uniq = [""]
             labels = np.zeros(len(rows), np.int32)
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+        vals_b, labels_b = maybe_shard_batch(self.auto_mesh(conf), vals, labels)
         cnt, s1, s2 = (np.asarray(a) for a in agg.class_moments(
-            vals, labels, len(uniq)))
+            vals_b, labels_b, len(uniq)))
 
         d = conf.field_delim
         lines: List[str] = []

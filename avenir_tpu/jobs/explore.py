@@ -74,7 +74,8 @@ class _CorrelationJob(Job):
         dst = conf.get_int_list("dest.attributes")
         class_ord = schema.class_field.ordinal if schema.class_field else None
         against_class = dst is not None and class_ord is not None and dst == [class_ord]
-        job = corr.CategoricalCorrelation(algorithm=self._algorithm(conf))
+        job = corr.CategoricalCorrelation(algorithm=self._algorithm(conf),
+                                          mesh=self.auto_mesh(conf))
         result = job.fit(
             ds,
             src=[ord_to_idx[o] for o in src] if src else None,

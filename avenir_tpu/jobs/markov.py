@@ -50,6 +50,7 @@ class MarkovStateTransitionModel(Job):
         enc = mk.SequenceEncoder(states) if states else None
         scale = conf.get_int("trans.prob.scale", 1)
         model, enc = mk.MarkovChain(
+            mesh=self.auto_mesh(conf),
             laplace=conf.get_float("laplace.smoothing", 1.0),
             scale=scale if scale > 1 else None).fit(seqs, encoder=enc)
         write_output(output_path, model.to_lines(delim=conf.field_delim))

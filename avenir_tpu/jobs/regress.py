@@ -85,7 +85,7 @@ class FisherDiscriminant(Job):
         _enc, ds, _rows = self.encode_input(conf, input_path)
         schema = self.load_schema(conf)
         names = [schema.field_by_ordinal(o).name for o in ds.cont_ordinals]
-        model = mfisher.FisherDiscriminant().fit(ds)
+        model = mfisher.FisherDiscriminant(mesh=self.auto_mesh(conf)).fit(ds)
         write_output(output_path,
                      model.to_lines(feature_names=names, delim=conf.field_delim))
         counters.set("Records", "Processed", ds.num_rows)

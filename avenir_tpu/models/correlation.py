@@ -58,11 +58,13 @@ class CategoricalCorrelation:
     destination variable of every pair.
     """
 
-    def __init__(self, algorithm: str = "cramerIndex", pair_chunk: int = 512):
+    def __init__(self, algorithm: str = "cramerIndex", pair_chunk: int = 512,
+                 mesh=None):
         if algorithm not in STATS:
             raise ValueError(f"unknown algorithm {algorithm!r}; known: {sorted(STATS)}")
         self.algorithm = algorithm
         self.pair_chunk = pair_chunk
+        self.mesh = mesh          # optional data mesh (parallel/mesh.py)
 
     def fit(
         self,
@@ -92,14 +94,16 @@ class CategoricalCorrelation:
             pair_names = [(names[i], names[j]) for i, j in pairs]
         b_dst = max(b, meta.num_classes) if against_class else b
         acc = agg.Accumulator()
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
         for ds in chunks:
-            codes = jnp.asarray(ds.codes)
+            codes, lab = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             for s in range(0, len(pairs), self.pair_chunk):
                 sl = pairs[s:s + self.pair_chunk]
                 ci = codes[:, [p[0] for p in sl]]
                 if against_class:
-                    lab = jnp.asarray(ds.labels)
-                    cj = jnp.broadcast_to(lab[:, None], (ds.num_rows, len(sl)))
+                    # codes.shape[0], not ds.num_rows: the sharded batch may
+                    # carry count-neutral pad rows
+                    cj = jnp.broadcast_to(lab[:, None], (codes.shape[0], len(sl)))
                 else:
                     cj = codes[:, [p[1] for p in sl]]
                 acc.add(f"c{s}", agg.pair_counts(ci, cj, b_dst))
@@ -121,13 +125,14 @@ class CategoricalCorrelation:
 class CramerCorrelation(CategoricalCorrelation):
     """Convenience subclass matching the reference job name."""
 
-    def __init__(self, pair_chunk: int = 512):
-        super().__init__("cramerIndex", pair_chunk)
+    def __init__(self, pair_chunk: int = 512, mesh=None):
+        super().__init__("cramerIndex", pair_chunk, mesh=mesh)
 
 
 class HeterogeneityReductionCorrelation(CategoricalCorrelation):
     """Concentration (Gini) or uncertainty coefficient, selected by the
     reference's ``heterogeneity.algorithm`` property values."""
 
-    def __init__(self, algorithm: str = "concentrationCoeff", pair_chunk: int = 512):
-        super().__init__(algorithm, pair_chunk)
+    def __init__(self, algorithm: str = "concentrationCoeff", pair_chunk: int = 512,
+                 mesh=None):
+        super().__init__(algorithm, pair_chunk, mesh=mesh)

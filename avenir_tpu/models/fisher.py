@@ -49,7 +49,11 @@ class FisherDiscriminantModel:
 
 
 class FisherDiscriminant:
+    def __init__(self, mesh=None):
+        self.mesh = mesh          # optional data mesh (parallel/mesh.py)
+
     def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]]) -> FisherDiscriminantModel:
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
         chunks = [data] if isinstance(data, EncodedDataset) else data
         acc = agg.Accumulator()
         meta = None
@@ -57,7 +61,8 @@ class FisherDiscriminant:
             meta = ds
             if ds.labels is None:
                 raise ValueError("fit requires labels")
-            cnt, s1, s2 = agg.class_moments(jnp.asarray(ds.cont), jnp.asarray(ds.labels),
+            cont_b, lab_b = maybe_shard_batch(self.mesh, ds.cont, ds.labels)
+            cnt, s1, s2 = agg.class_moments(cont_b, lab_b,
                                             ds.num_classes)
             acc.add("cnt", cnt)
             acc.add("s1", s1)

@@ -131,9 +131,11 @@ class MarkovChainModel:
 class MarkovChain:
     """First-order chain trainer over state-name sequences."""
 
-    def __init__(self, laplace: float = 1.0, scale: Optional[int] = None):
+    def __init__(self, laplace: float = 1.0, scale: Optional[int] = None,
+                 mesh=None):
         self.laplace = laplace
         self.scale = scale
+        self.mesh = mesh          # optional data mesh (parallel/mesh.py)
 
     def fit(self, seqs: Sequence[Sequence[str]],
             encoder: Optional[SequenceEncoder] = None) -> Tuple[MarkovChainModel, SequenceEncoder]:
@@ -141,8 +143,9 @@ class MarkovChain:
         codes, _ = enc.encode(seqs)
         s = len(enc)
         a, b = adjacent_pairs(codes)
-        counts = np.asarray(agg.transition_counts(jnp.asarray(a), jnp.asarray(b), s, s),
-                            np.float64)
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+        a_b, b_b = maybe_shard_batch(self.mesh, a, b)   # -1 pads count-neutral
+        counts = np.asarray(agg.transition_counts(a_b, b_b, s, s), np.float64)
         return MarkovChainModel(states=list(enc.symbols), counts=counts,
                                 laplace=self.laplace, scale=self.scale), enc
 
