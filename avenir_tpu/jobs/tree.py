@@ -76,8 +76,10 @@ class ClassPartitionGenerator(Job):
         # honor the reference's externally supplied parent info content (from
         # the at.root bootstrap); default = derive from the node itself
         parent_info = conf.get_float("parent.info")
-        labels = jnp.asarray(ds.labels)
-        node_ids = jnp.zeros(ds.num_rows, jnp.int32)
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+        mesh = self.auto_mesh(conf)
+        labels, node_ids = maybe_shard_batch(
+            mesh, ds.labels, np.zeros(ds.num_rows, np.int32))
         lines: List[str] = []
         out_distr = conf.get_bool("output.split.prob", False)
         split_chunk = conf.get_int("split.chunk", 128)
@@ -92,7 +94,7 @@ class ClassPartitionGenerator(Job):
                 seg_codes = seg_tab[:, col].T                         # [N, S]
                 gmax = max(sp.num_segments for sp in chunk)
                 hist = dtree.split_node_histograms(
-                    jnp.asarray(seg_codes), node_ids, labels,
+                    maybe_shard_batch(mesh, seg_codes)[0], node_ids, labels,
                     gmax, 1, ds.num_classes)
                 scores = np.asarray(dtree.split_scores(
                     hist, p["algorithm"], parent_info=parent_info))
@@ -201,6 +203,7 @@ class DecisionTreeBuilder(Job):
             max_depth=conf.get_int("max.depth", 4),
             min_node_size=conf.get_int("min.node.size", 32),
             seed=conf.get_int("seed", 0),
+            mesh=self.auto_mesh(conf),
         )
         model = trainer.fit(ds, is_cat)
         write_output(output_path, [model.to_string(),

@@ -362,6 +362,7 @@ class DecisionTree:
         max_candidates_per_attr: int = 128,
         split_chunk: int = 128,
         seed: int = 0,
+        mesh=None,
     ):
         if algorithm not in ALGORITHMS:
             raise ValueError(f"unknown algorithm {algorithm!r}; known: {ALGORITHMS}")
@@ -377,6 +378,7 @@ class DecisionTree:
         self.max_candidates_per_attr = max_candidates_per_attr
         self.split_chunk = split_chunk
         self.seed = seed
+        self.mesh = mesh          # optional data mesh (parallel/mesh.py)
 
     def _attrs_for_node(self, rng: np.random.Generator, num_attrs: int) -> List[int]:
         if self.attr_strategy == "userSpecified":
@@ -394,10 +396,13 @@ class DecisionTree:
             is_categorical: Optional[Sequence[bool]] = None) -> DecisionTreeModel:
         if ds.labels is None:
             raise ValueError("fit requires labels")
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+
         rng = np.random.default_rng(self.seed)
         n, c = ds.num_rows, ds.num_classes
-        codes_dev = jnp.asarray(ds.codes)
-        labels_dev = jnp.asarray(ds.labels)
+        # batch-sharded under a data mesh: pad rows carry -1 labels/node ids
+        # /segment codes, all count-neutral in the histogram contraction
+        labels_dev = maybe_shard_batch(self.mesh, ds.labels)[0]
         all_splits = generate_candidate_splits(
             ds, self.max_split, is_categorical, self.max_candidates_per_attr)
 
@@ -415,7 +420,7 @@ class DecisionTree:
             for i, nid in enumerate(frontier):
                 remap[nid] = i
             local_node = remap[node_of_record]                 # −1 for settled rows
-            local_node_dev = jnp.asarray(local_node)
+            local_node_dev = maybe_shard_batch(self.mesh, local_node)[0]
 
             best_per_node: List[List[Tuple[float, CandidateSplit, np.ndarray]]] = [
                 [] for _ in range(k)]
@@ -430,8 +435,8 @@ class DecisionTree:
                     seg_codes = seg_tab[:, col].T                           # [N, S]
                     gmax = max(sp.num_segments for sp in chunk)
                     hist = split_node_histograms(
-                        jnp.asarray(seg_codes), local_node_dev, labels_dev,
-                        gmax, k, c)
+                        maybe_shard_batch(self.mesh, seg_codes)[0],
+                        local_node_dev, labels_dev, gmax, k, c)
                     scores = np.asarray(split_scores(hist, self.algorithm))  # [S, K]
                     hist_np = np.asarray(hist)
                     for si, sp in enumerate(chunk):

@@ -333,3 +333,29 @@ def test_disease_rule_mining_recovers_age_driver(tmp_path):
     hlines = [ln.split(";") for ln in read_lines(str(tmp_path / "hsplits"))]
     hbest = max(hlines, key=lambda r: float(r[2]))
     assert hbest[0] == "1", f"expected age split under hellinger, got {hbest}"
+
+
+def test_tree_builder_meshed_identical_to_single(tmp_path):
+    # tree induction under the auto data mesh: pad rows carry -1 node ids/
+    # labels/segment codes (count-neutral), so the grown tree is identical
+    import json as js
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.retarget import RETARGET_SCHEMA_JSON, generate_retarget
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    # 2999: NOT divisible by the 8-device mesh, so the -1 pad-row
+    # count-neutrality is actually exercised
+    write_csv(str(tmp_path / "d.csv"), generate_retarget(2999, seed=6))
+    (tmp_path / "r.json").write_text(js.dumps(RETARGET_SCHEMA_JSON))
+    base = {"feature.schema.file.path": str(tmp_path / "r.json"),
+            "max.depth": "4"}
+    get_job("DecisionTreeBuilder").run(JobConfig(base),
+                                       str(tmp_path / "d.csv"),
+                                       str(tmp_path / "t_mesh"))
+    get_job("DecisionTreeBuilder").run(
+        JobConfig({**base, "data.parallel.auto": "false"}),
+        str(tmp_path / "d.csv"), str(tmp_path / "t_single"))
+    assert read_lines(str(tmp_path / "t_mesh")) == \
+        read_lines(str(tmp_path / "t_single"))
