@@ -137,6 +137,7 @@ class NearestNeighbor(Job):
             pos_class=conf.get("positive.class.value"),
             cost=cost,
             search_mode=conf.get("knn.search.mode", "exact"),
+            mesh=self.auto_mesh(conf),
         )
         out: List[str] = []
         if regression:

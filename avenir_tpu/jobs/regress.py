@@ -61,6 +61,7 @@ class LogisticRegressionJob(Job):
                 convergence=conf.get("convergence.criteria", "average"),
                 threshold_pct=conf.get_float("convergence.threshold", 0.5),
                 l2=conf.get_float("l2.weight", 0.0),
+                mesh=self.auto_mesh(conf),
             )
             model = est.fit(x, y, resume_from=resume)
             hist = model.history_lines(delim=conf.field_delim)

@@ -260,10 +260,54 @@ def _nearest_neighbors_pallas(model: KNNModel, test: EncodedDataset, k: int
     return d, idx
 
 
+def _nearest_neighbors_sharded(model: KNNModel, test: EncodedDataset, k: int,
+                               metric: str, mesh, test_tile: int
+                               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Reference rows sharded over the mesh's ``data`` axis, exact global
+    top-k via one all_gather merge (parallel/collectives.sharded_knn_topk,
+    lru-cached so repeated queries reuse the compiled program). The sharded
+    reference set is cached on the model like device_tiles.
+
+    The per-device step materializes a [test_tile, N/D] local distance
+    slice, so the test tile is capped to keep that slice bounded (~256 MB
+    f32 per device) — the mesh analog of the XLA path's ref-axis tiling."""
+    from avenir_tpu.parallel import collectives
+    from avenir_tpu.parallel.mesh import device_put_sharded_batch
+
+    n = model.num_refs
+    d_par = mesh.shape["data"]
+    nb = int(model.n_bins.max()) if model.n_bins.size else 1
+    k_eff = min(k, n)
+    cache = model.__dict__.setdefault("_dev_sharded", {})
+    key = (id(mesh), d_par)
+    if key not in cache:
+        # pad fill −1 is safe: pad rows are masked by global index ≥ n_real
+        cache[key] = tuple(device_put_sharded_batch(
+            mesh, model.codes, model.cont))
+    rc_s, rx_s = cache[key]
+    step = collectives.sharded_knn_topk(mesh, k=k_eff, num_bins=nb,
+                                        metric=metric)
+    local_n = max(-(-n // d_par), 1)
+    test_tile = max(min(test_tile, (64 << 20) // local_n), 16)
+    lo, hi = jnp.asarray(model.cont_lo), jnp.asarray(model.cont_hi)
+    out_d, out_i = [], []
+    for m0 in range(0, test.num_rows, test_tile):
+        bd, bi = step(jnp.asarray(test.codes[m0:m0 + test_tile]),
+                      jnp.asarray(test.cont[m0:m0 + test_tile]),
+                      rc_s, rx_s, lo, hi, jnp.int32(n))
+        out_d.append(np.asarray(bd))
+        out_i.append(np.asarray(bi))
+    d = np.concatenate(out_d); i = np.concatenate(out_i)
+    if k_eff < k:
+        d = np.pad(d, ((0, 0), (0, k - k_eff)), constant_values=np.inf)
+        i = np.pad(i, ((0, 0), (0, k - k_eff)), constant_values=-1)
+    return d, i
+
+
 def nearest_neighbors(
     model: KNNModel, test: EncodedDataset, k: int,
     metric: str = "euclidean", ref_tile: int = 65536, test_tile: int = 8192,
-    mode: str = "exact",
+    mode: str = "exact", mesh=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """([M, k] distances, [M, k] reference indices), ascending by distance.
 
@@ -282,6 +326,13 @@ def nearest_neighbors(
                                       test_tile, approx=True)
     if mode != "exact":
         raise ValueError(f"unknown search mode {mode!r}; use exact|approx")
+    if mesh is not None and mesh.shape.get("data", 1) > 1:
+        d_par = mesh.shape["data"]
+        from avenir_tpu.parallel.mesh import padded_size
+        # the all_gather merge needs k candidates per device shard
+        if min(k, model.num_refs) <= padded_size(model.num_refs, d_par) // d_par:
+            return _nearest_neighbors_sharded(model, test, k, metric, mesh,
+                                              test_tile)
     if _pallas_available(metric, k) and min(k, model.num_refs) == k:
         return _nearest_neighbors_pallas(model, test, k)
     return _nearest_neighbors_xla(model, test, k, metric, ref_tile, test_tile)
@@ -365,6 +416,7 @@ class KNN:
         ref_tile: int = 65536,
         test_tile: int = 8192,
         search_mode: str = "exact",
+        mesh=None,
     ):
         if kernel not in KERNELS:
             raise ValueError(f"unknown kernel {kernel!r}; known: {KERNELS}")
@@ -382,6 +434,7 @@ class KNN:
         self.cost = cost
         self.ref_tile = ref_tile
         self.test_tile = test_tile
+        self.mesh = mesh          # optional data mesh: shards the reference set
 
     def fit(self, ds: EncodedDataset, values: Optional[np.ndarray] = None,
             class_probs: Optional[np.ndarray] = None) -> KNNModel:
@@ -394,7 +447,7 @@ class KNN:
             raise ValueError("classification requires labels in the reference set")
         dists, idx = nearest_neighbors(model, test, self.k, self.metric,
                                        self.ref_tile, self.test_tile,
-                                       mode=self.search_mode)
+                                       mode=self.search_mode, mesh=self.mesh)
         w = kernel_weights(dists, self.kernel, self.kernel_sigma, self.inverse_distance)
         neigh_labels = model.labels[idx]                        # [M, k]
         c = len(model.class_values)
@@ -445,7 +498,7 @@ class KNN:
             raise ValueError("regression requires target values in the model")
         dists, idx = nearest_neighbors(model, test, self.k, self.metric,
                                        self.ref_tile, self.test_tile,
-                                       mode=self.search_mode)
+                                       mode=self.search_mode, mesh=self.mesh)
         vals = model.values[idx]                                # [M, k]
         if method == "average":
             w = kernel_weights(dists, self.kernel, self.kernel_sigma, self.inverse_distance)

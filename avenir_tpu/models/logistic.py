@@ -52,11 +52,15 @@ def design_matrix(ds: EncodedDataset, include_binned: bool = True,
 
 
 @jax.jit
-def _grad_step(w: jax.Array, x: jax.Array, y: jax.Array,
+def _grad_step(w: jax.Array, x: jax.Array, y: jax.Array, n: jax.Array,
                lr: jax.Array, l2: jax.Array) -> jax.Array:
-    """One full-batch gradient-ascent step on the log-likelihood."""
+    """One full-batch gradient-ascent step on the log-likelihood.
+
+    ``n`` is the TRUE row count — under a data mesh the batch may carry
+    zero pad rows (x=0 ⇒ zero gradient contribution) that must not dilute
+    the 1/n scaling."""
     p = jax.nn.sigmoid(x @ w)
-    grad = x.T @ (y - p) / x.shape[0] - l2 * w
+    grad = x.T @ (y - p) / n - l2 * w
     return w + lr * grad
 
 
@@ -100,6 +104,7 @@ class LogisticRegression:
         convergence: str = "average",        # 'all' | 'average'
         threshold_pct: float = 0.5,
         l2: float = 0.0,
+        mesh=None,
     ):
         if convergence not in ("all", "average"):
             raise ValueError("convergence must be 'all' or 'average'")
@@ -107,6 +112,7 @@ class LogisticRegression:
         self.max_iterations = max_iterations
         self.convergence = convergence
         self.threshold_pct = threshold_pct
+        self.mesh = mesh          # optional data mesh (parallel/mesh.py)
         self.l2 = l2
 
     def fit(self, x: np.ndarray, y: np.ndarray,
@@ -114,8 +120,14 @@ class LogisticRegression:
         """y must be 0/1. ``resume_from`` continues a previous run from its
         last coefficient row (the reference restarts its driver loop reading
         the last line of the coefficient file)."""
-        xd = jnp.asarray(x, jnp.float32)
-        yd = jnp.asarray(y, jnp.float32)
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+        # zero pad rows contribute a zero gradient term; _grad_step scales
+        # by the true n passed below, so sharding is transparent up to
+        # float reduction order
+        xd, yd = maybe_shard_batch(self.mesh,
+                                   np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32))
+        n_true = jnp.float32(x.shape[0])
         lr = jnp.float32(self.learning_rate)
         l2 = jnp.float32(self.l2)
         if resume_from is not None:
@@ -127,7 +139,7 @@ class LogisticRegression:
         converged = False
         it = 0
         for it in range(1, self.max_iterations + 1):
-            w_new = _grad_step(w, xd, yd, lr, l2)
+            w_new = _grad_step(w, xd, yd, n_true, lr, l2)
             cur = np.asarray(w_new)
             history.append(cur)
             if len(history) >= 2 and _converged(history[-2], cur,

@@ -98,6 +98,7 @@ def sharded_nb_fit_step_2d(mesh: Mesh, num_classes: int, num_bins: int):
     return jax.jit(wrapped)
 
 
+@functools.lru_cache(maxsize=32)
 def sharded_knn_topk(mesh: Mesh, k: int, num_bins: int,
                      metric: str = "euclidean", data_axis: str = "data"):
     """Exact global k-NN with the reference set sharded over the mesh.

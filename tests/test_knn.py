@@ -213,3 +213,31 @@ def test_unknown_search_mode_raises(rng):
         knn_mod.nearest_neighbors(model, ds, k=3, mode="wat")
     with pytest.raises(ValueError):
         knn_mod.KNN(k=3, search_mode="wat")
+
+
+def test_nearest_neighbors_mesh_matches_local(rng):
+    # reference rows sharded over the 8-device mesh, exact all_gather merge:
+    # neighbor sets must equal the single-device scan (2999 refs: the shard
+    # padding path engages)
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    n, m, k = 2999, 64, 5
+    ds = EncodedDataset(
+        codes=rng.integers(0, 6, size=(n, 3)).astype(np.int32),
+        cont=rng.normal(size=(n, 4)).astype(np.float32),
+        labels=rng.integers(0, 2, size=n).astype(np.int32),
+        ids=None, n_bins=np.full(3, 6, np.int32), class_values=["a", "b"],
+        binned_ordinals=[0, 1, 2], cont_ordinals=[3, 4, 5, 6])
+    test = EncodedDataset(
+        codes=rng.integers(0, 6, size=(m, 3)).astype(np.int32),
+        cont=rng.normal(size=(m, 4)).astype(np.float32),
+        labels=None, ids=None, n_bins=ds.n_bins, class_values=ds.class_values,
+        binned_ordinals=ds.binned_ordinals, cont_ordinals=ds.cont_ordinals)
+    model = knn_mod.fit_knn(ds)
+    mesh = make_mesh(("data",))
+    d_mesh, i_mesh = knn_mod.nearest_neighbors(model, test, k=k, mesh=mesh)
+    d_loc, i_loc = knn_mod.nearest_neighbors(model, test, k=k)
+    np.testing.assert_allclose(d_mesh, d_loc, rtol=1e-5, atol=1e-6)
+    # index sets must agree (order within distance ties may differ)
+    for q in range(m):
+        assert set(i_mesh[q]) == set(i_loc[q]), q

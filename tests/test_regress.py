@@ -127,3 +127,22 @@ def test_fisher_requires_binary_and_continuous(rng):
         n_bins=np.array([2], np.int32), class_values=["a", "b"])
     with pytest.raises(ValueError):
         FisherDiscriminant().fit(ds_nc)
+
+
+def test_lr_mesh_matches_single_device(rng):
+    from avenir_tpu.models import logistic as mlr
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    n, d = 1999, 4                       # not divisible by 8: pads engage
+    x = np.concatenate([rng.normal(size=(n, d)), np.ones((n, 1))], axis=1)
+    w_true = np.array([1.5, -2.0, 0.5, 0.0, 0.3])
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float32)
+    # threshold_pct=0 disables early stop: reduction-order float noise must
+    # not flip the convergence check one iteration apart between runs
+    kw = dict(learning_rate=0.5, max_iterations=40, threshold_pct=0.0)
+    m_single = mlr.LogisticRegression(**kw).fit(x.astype(np.float32), y)
+    m_mesh = mlr.LogisticRegression(mesh=make_mesh(("data",)), **kw).fit(
+        x.astype(np.float32), y)
+    assert m_mesh.iterations == m_single.iterations
+    np.testing.assert_allclose(m_mesh.weights, m_single.weights,
+                               rtol=1e-4, atol=1e-5)
