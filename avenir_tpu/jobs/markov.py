@@ -72,7 +72,7 @@ class HiddenMarkovModelBuilder(Job):
         sub = conf.get("sub.field.delim", ":")
         skip = conf.get_int("skip.field.count", 1)
         seqs = _sequences(input_path, delim, skip)
-        builder = mk.HMMBuilder(laplace=conf.get_float("laplace.smoothing", 1.0))
+        builder = mk.HMMBuilder(mesh=self.auto_mesh(conf), laplace=conf.get_float("laplace.smoothing", 1.0))
         states = conf.get_list("model.states")
         obs_vocab = conf.get_list("model.observations")
         obs_enc = mk.SequenceEncoder(obs_vocab) if obs_vocab else None

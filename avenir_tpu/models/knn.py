@@ -260,35 +260,53 @@ def _nearest_neighbors_pallas(model: KNNModel, test: EncodedDataset, k: int
     return d, idx
 
 
+def _shard_rows(n: int, d_par: int) -> int:
+    """ceil(n / d_par) — the per-device shard row count; one spelling shared
+    by the mesh routing gate and the sharded search path."""
+    return max(-(-n // d_par), 1)
+
+
+def _pad_topk(d: np.ndarray, i: np.ndarray, k: int, k_eff: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Keep the [M, k] contract when the reference set has fewer than k
+    rows: pad with +inf distances and -1 indices."""
+    if k_eff < k:
+        d = np.pad(d, ((0, 0), (0, k - k_eff)), constant_values=np.inf)
+        i = np.pad(i, ((0, 0), (0, k - k_eff)), constant_values=-1)
+    return d, i
+
+
 def _nearest_neighbors_sharded(model: KNNModel, test: EncodedDataset, k: int,
-                               metric: str, mesh, test_tile: int
+                               metric: str, mesh, test_tile: int,
+                               ref_tile: int = 65536,
                                ) -> Tuple[np.ndarray, np.ndarray]:
     """Reference rows sharded over the mesh's ``data`` axis, exact global
     top-k via one all_gather merge (parallel/collectives.sharded_knn_topk,
     lru-cached so repeated queries reuse the compiled program). The sharded
-    reference set is cached on the model like device_tiles.
-
-    The per-device step materializes a [test_tile, N/D] local distance
-    slice, so the test tile is capped to keep that slice bounded (~256 MB
-    f32 per device) — the mesh analog of the XLA path's ref-axis tiling."""
+    reference set is cached on the model like device_tiles; each device
+    scans its shard in ``ref_tile``-row tiles, so per-device memory is
+    bounded exactly like the single-device scan."""
     from avenir_tpu.parallel import collectives
-    from avenir_tpu.parallel.mesh import device_put_sharded_batch
+    from avenir_tpu.parallel.mesh import data_sharding, pad_batch
 
     n = model.num_refs
     d_par = mesh.shape["data"]
     nb = int(model.n_bins.max()) if model.n_bins.size else 1
     k_eff = min(k, n)
+    shard = _shard_rows(n, d_par)
+    tile = min(ref_tile, shard)
+    padded_local = -(-shard // tile) * tile        # whole tiles per device
+    npad = padded_local * d_par
     cache = model.__dict__.setdefault("_dev_sharded", {})
-    key = (id(mesh), d_par)
+    key = (mesh, tile)                             # Mesh is hashable
     if key not in cache:
         # pad fill −1 is safe: pad rows are masked by global index ≥ n_real
-        cache[key] = tuple(device_put_sharded_batch(
-            mesh, model.codes, model.cont))
+        rc, rx = pad_batch(npad, model.codes, model.cont)
+        cache[key] = (jax.device_put(rc, data_sharding(mesh, 2)),
+                      jax.device_put(rx, data_sharding(mesh, 2)))
     rc_s, rx_s = cache[key]
     step = collectives.sharded_knn_topk(mesh, k=k_eff, num_bins=nb,
-                                        metric=metric)
-    local_n = max(-(-n // d_par), 1)
-    test_tile = max(min(test_tile, (64 << 20) // local_n), 16)
+                                        metric=metric, ref_tile=tile)
     lo, hi = jnp.asarray(model.cont_lo), jnp.asarray(model.cont_hi)
     out_d, out_i = [], []
     for m0 in range(0, test.num_rows, test_tile):
@@ -297,11 +315,7 @@ def _nearest_neighbors_sharded(model: KNNModel, test: EncodedDataset, k: int,
                       rc_s, rx_s, lo, hi, jnp.int32(n))
         out_d.append(np.asarray(bd))
         out_i.append(np.asarray(bi))
-    d = np.concatenate(out_d); i = np.concatenate(out_i)
-    if k_eff < k:
-        d = np.pad(d, ((0, 0), (0, k - k_eff)), constant_values=np.inf)
-        i = np.pad(i, ((0, 0), (0, k - k_eff)), constant_values=-1)
-    return d, i
+    return _pad_topk(np.concatenate(out_d), np.concatenate(out_i), k, k_eff)
 
 
 def nearest_neighbors(
@@ -321,18 +335,19 @@ def nearest_neighbors(
     where the Pallas kernel cannot run (manhattan metric, k > kernel
     slots, non-TPU backends); a capability knob the reference has no
     analog for, OFF unless asked for."""
+    if mode not in ("exact", "approx"):
+        raise ValueError(f"unknown search mode {mode!r}; use exact|approx")
+    if mesh is not None and mesh.shape.get("data", 1) > 1:
+        # the sharded-reference path is exact AND parallel, so it serves
+        # both modes (an approx request gets ≥-quality results); the
+        # all_gather merge needs k candidates per device shard
+        if min(k, model.num_refs) <= _shard_rows(model.num_refs,
+                                                 mesh.shape["data"]):
+            return _nearest_neighbors_sharded(model, test, k, metric, mesh,
+                                              test_tile, ref_tile)
     if mode == "approx":
         return _nearest_neighbors_xla(model, test, k, metric, ref_tile,
                                       test_tile, approx=True)
-    if mode != "exact":
-        raise ValueError(f"unknown search mode {mode!r}; use exact|approx")
-    if mesh is not None and mesh.shape.get("data", 1) > 1:
-        d_par = mesh.shape["data"]
-        from avenir_tpu.parallel.mesh import padded_size
-        # the all_gather merge needs k candidates per device shard
-        if min(k, model.num_refs) <= padded_size(model.num_refs, d_par) // d_par:
-            return _nearest_neighbors_sharded(model, test, k, metric, mesh,
-                                              test_tile)
     if _pallas_available(metric, k) and min(k, model.num_refs) == k:
         return _nearest_neighbors_pallas(model, test, k)
     return _nearest_neighbors_xla(model, test, k, metric, ref_tile, test_tile)
@@ -358,12 +373,8 @@ def _nearest_neighbors_xla(
             approx=approx)
         out_d.append(np.asarray(best_d))
         out_i.append(np.asarray(best_i))
-    d = np.concatenate(out_d); i = np.concatenate(out_i)
-    if k_eff < k:           # degenerate tiny reference sets: keep [M, k] shape
-        pad = k - k_eff
-        d = np.pad(d, ((0, 0), (0, pad)), constant_values=np.inf)
-        i = np.pad(i, ((0, 0), (0, pad)), constant_values=-1)
-    return d, i
+    # degenerate tiny reference sets: keep the [M, k] shape
+    return _pad_topk(np.concatenate(out_d), np.concatenate(out_i), k, k_eff)
 
 
 # ---------------------------------------------------------------------------

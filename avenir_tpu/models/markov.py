@@ -189,8 +189,9 @@ class HMMModel:
 class HMMBuilder:
     """Supervised HMM estimation from tagged sequences."""
 
-    def __init__(self, laplace: float = 1.0):
+    def __init__(self, laplace: float = 1.0, mesh=None):
         self.laplace = laplace
+        self.mesh = mesh          # optional data mesh (parallel/mesh.py)
 
     def fit_tagged(
         self,
@@ -207,15 +208,18 @@ class HMMBuilder:
         s, o = len(st_enc), len(ob_enc)
         # initial states
         init = np.bincount(st_codes[:, 0][st_codes[:, 0] >= 0], minlength=s).astype(np.float64)
-        # transitions
-        a_src, a_dst = adjacent_pairs(st_codes)
-        trans = np.asarray(agg.transition_counts(jnp.asarray(a_src), jnp.asarray(a_dst), s, s),
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+        # transitions (−1 pads are count-neutral under one-hot)
+        a_src, a_dst = maybe_shard_batch(self.mesh, *adjacent_pairs(st_codes))
+        trans = np.asarray(agg.transition_counts(a_src, a_dst, s, s),
                            np.float64)
         # emissions: state/obs pairs at the same position
         valid = (st_codes >= 0) & (ob_codes >= 0)
-        st_flat = np.where(valid, st_codes, -1).ravel()
-        ob_flat = np.where(valid, ob_codes, -1).ravel()
-        emit = np.asarray(agg.transition_counts(jnp.asarray(st_flat), jnp.asarray(ob_flat), s, o),
+        st_flat, ob_flat = maybe_shard_batch(
+            self.mesh,
+            np.where(valid, st_codes, -1).ravel(),
+            np.where(valid, ob_codes, -1).ravel())
+        emit = np.asarray(agg.transition_counts(st_flat, ob_flat, s, o),
                           np.float64)
         return self._normalize(st_enc, ob_enc, trans, emit, init)
 

@@ -100,40 +100,67 @@ def sharded_nb_fit_step_2d(mesh: Mesh, num_classes: int, num_bins: int):
 
 @functools.lru_cache(maxsize=32)
 def sharded_knn_topk(mesh: Mesh, k: int, num_bins: int,
-                     metric: str = "euclidean", data_axis: str = "data"):
+                     metric: str = "euclidean", data_axis: str = "data",
+                     ref_tile: int = 65536):
     """Exact global k-NN with the reference set sharded over the mesh.
 
     The reference outsources its O(M·N) all-pairs distances to a Hadoop job
     (resource/knn.sh:47-60); the multi-chip spelling here shards the
-    reference rows over ``data`` (queries replicated), computes per-device
-    distances + local top-k on the MXU, then merges with one
-    ``lax.all_gather`` of the [M, k] candidates — k·D values per query cross
-    ICI instead of the N-row distance matrix.
+    reference rows over ``data`` (queries replicated), and each device scans
+    its local shard in ``ref_tile``-row tiles with a running exact top-k —
+    the same bounded-memory discipline as the single-device scan, so
+    per-device memory is O(M·ref_tile), never O(M·N/D) — then merges with
+    one ``lax.all_gather`` of the [M, k] candidates: k·D values per query
+    cross ICI instead of the N-row distance matrix.
 
     Returns a jitted fn(test_codes, test_cont, ref_codes, ref_cont, lo, hi,
     n_real) → ([M, k] distances, [M, k] global reference indices). The
-    reference arrays must be padded to a multiple of the data-axis size;
-    pad rows (global index ≥ n_real) are masked to +inf so they can never
-    win the top-k. Requires k ≤ padded-N/D.
+    reference arrays must be padded so each device's shard is a whole
+    number of ``ref_tile`` tiles; pad rows (global index ≥ n_real) are
+    masked to +inf so they can never win the top-k. Requires k ≤ local
+    shard rows. Cached per (mesh, k, bins, metric, tile) so repeated
+    queries reuse the compiled program.
     """
     from avenir_tpu.models.knn import _tile_distances
 
     def step(tc, tx, rc, rx, lo, hi, n_real):
-        d = _tile_distances(tc, tx, rc, rx, lo, hi, num_bins, metric)
-        base = jax.lax.axis_index(data_axis) * rc.shape[0]
-        local_idx = base + jnp.arange(rc.shape[0], dtype=jnp.int32)
-        d = jnp.where(local_idx[None, :] < n_real, d, jnp.inf)
-        neg, pos = jax.lax.top_k(-d, k)                     # local top-k
-        gidx = local_idx[pos]
+        local = rc.shape[0]
+        # whole shard as one tile when it isn't tile-divisible (direct
+        # callers with small shards); _nearest_neighbors_sharded pads the
+        # global array so production shards always divide
+        tile = ref_tile if local >= ref_tile and local % ref_tile == 0 \
+            else local
+        t = local // tile
+        rc_t = rc.reshape(t, tile, rc.shape[1])
+        rx_t = rx.reshape(t, tile, rx.shape[1])
+        m = tc.shape[0] if tc.size else tx.shape[0]
+        base = jax.lax.axis_index(data_axis) * local
+
+        def body(carry, xs):
+            best_d, best_i, t0 = carry
+            rct, rxt = xs
+            d = _tile_distances(tc, tx, rct, rxt, lo, hi, num_bins, metric)
+            idx = base + t0 + jnp.arange(tile, dtype=jnp.int32)
+            d = jnp.where(idx[None, :] < n_real, d, jnp.inf)
+            cd = jnp.concatenate([best_d, d], axis=1)
+            cix = jnp.concatenate(
+                [best_i, jnp.broadcast_to(idx[None, :], d.shape)], axis=1)
+            neg, pos = jax.lax.top_k(-cd, k)
+            return (-neg, jnp.take_along_axis(cix, pos, axis=1),
+                    t0 + jnp.int32(tile)), None
+
+        best_d = jnp.full((m, k), jnp.inf, jnp.float32)
+        best_i = jnp.full((m, k), -1, jnp.int32)
+        (best_d, best_i, _), _ = jax.lax.scan(
+            body, (best_d, best_i, jnp.int32(0)), (rc_t, rx_t))
         # [M, D·k] candidates on every device, then the final exact top-k
-        dg = jax.lax.all_gather(-neg, data_axis, axis=1, tiled=True)
-        ig = jax.lax.all_gather(gidx, data_axis, axis=1, tiled=True)
+        dg = jax.lax.all_gather(best_d, data_axis, axis=1, tiled=True)
+        ig = jax.lax.all_gather(best_i, data_axis, axis=1, tiled=True)
         neg2, pos2 = jax.lax.top_k(-dg, k)
         return -neg2, jnp.take_along_axis(ig, pos2, axis=1)
 
     # the outputs are replicated (every device holds the same merged top-k
-    # after the all_gather), but shard_map cannot infer that statically —
-    # disable the replication check (kwarg renamed across jax versions)
+    # after the all_gather), but shard_map cannot infer that statically
     in_specs = (P(), P(), P(data_axis, None), P(data_axis, None), P(), P(), P())
     wrapped = _shard_map_norep(step, mesh, in_specs, (P(), P()))
     return jax.jit(wrapped)

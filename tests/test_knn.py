@@ -241,3 +241,10 @@ def test_nearest_neighbors_mesh_matches_local(rng):
     # index sets must agree (order within distance ties may differ)
     for q in range(m):
         assert set(i_mesh[q]) == set(i_loc[q]), q
+    # small ref_tile: each device scans multiple tiles (the bounded-memory
+    # path), same exact results
+    d_t, i_t = knn_mod.nearest_neighbors(model, test, k=k, mesh=mesh,
+                                         ref_tile=128)
+    np.testing.assert_allclose(d_t, d_loc, rtol=1e-5, atol=1e-6)
+    for q in range(m):
+        assert set(i_t[q]) == set(i_loc[q]), q
