@@ -131,6 +131,28 @@ class DatasetEncoder:
             self.class_values = list(self.class_field.cardinality)
             self.class_map = {v: i for i, v in enumerate(self.class_values)}
 
+    def max_ordinal(self, with_labels: bool = True) -> int:
+        """Largest CSV column ordinal any consumed field reads — callers
+        validating a row width must ensure ``ncols > max_ordinal``."""
+        ords = [f.ordinal for f in self.binned_fields + self.cont_fields]
+        if self.id_field is not None:
+            ords.append(self.id_field.ordinal)
+        if with_labels and self.class_field is not None:
+            ords.append(self.class_field.ordinal)
+        return max(ords, default=-1)
+
+    def schema_complete(self, with_labels: bool = True) -> bool:
+        """True when the schema fully specified every vocabulary/bin range
+        (and class values, if ``with_labels``) — i.e. :meth:`transform`
+        works without a data-fitting pass, the contract the reference's
+        mappers rely on and the one the native fast path requires."""
+        for f in self.binned_fields:
+            if f.ordinal not in self.vocab and f.ordinal not in self.bin_offset:
+                return False
+        if with_labels and self.class_field is not None and not self.class_values:
+            return False
+        return True
+
     # -- fitting -------------------------------------------------------------
     def fit(self, rows: np.ndarray) -> "DatasetEncoder":
         """Learn vocabularies / bin ranges not fully specified by the schema."""

@@ -16,9 +16,12 @@ import numpy as np
 HOSP_SCHEMA_JSON = {
     "fields": [
         {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
-        {"name": "age", "ordinal": 1, "dataType": "int", "feature": True, "bucketWidth": 10},
-        {"name": "weight", "ordinal": 2, "dataType": "int", "feature": True, "bucketWidth": 10},
-        {"name": "height", "ordinal": 3, "dataType": "int", "feature": True, "bucketWidth": 5},
+        {"name": "age", "ordinal": 1, "dataType": "int", "feature": True,
+         "bucketWidth": 10, "min": 10, "max": 90},
+        {"name": "weight", "ordinal": 2, "dataType": "int", "feature": True,
+         "bucketWidth": 10, "min": 130, "max": 250},
+        {"name": "height", "ordinal": 3, "dataType": "int", "feature": True,
+         "bucketWidth": 5, "min": 50, "max": 75},
         {"name": "employmentStatus", "ordinal": 4, "dataType": "categorical", "feature": True,
          "cardinality": ["employed", "unemployed", "retired"]},
         {"name": "familyStatus", "ordinal": 5, "dataType": "categorical", "feature": True,

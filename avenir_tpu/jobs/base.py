@@ -146,14 +146,72 @@ class Job:
     @staticmethod
     def encode_input(conf: JobConfig, input_path: str,
                      with_labels: bool = True,
-                     encoder: Optional[DatasetEncoder] = None):
-        """(encoder, encoded dataset) for whole-input jobs."""
+                     encoder: Optional[DatasetEncoder] = None,
+                     need_rows: bool = True):
+        """(encoder, encoded dataset, raw rows) for whole-input jobs.
+
+        ``need_rows=False`` (train/analyze jobs that never echo the raw
+        fields) unlocks the native C++ encode path: CSV bytes go straight
+        through ``runtime.native.encode_bytes`` (~3× the Python
+        parse+transform) when the library is built, the schema is complete
+        (vocabularies/bins/class values pre-declared — the same condition
+        streaming train needs), and the delimiter is a single char; raw
+        ``rows`` come back as None on that path. Identical encode semantics
+        either way (tests/test_native.py parity suite)."""
         delim = conf.field_delim_regex
-        rows = read_input(input_path, delim=delim)
         enc = encoder or Job.encoder_for(conf)
+        if not need_rows and len(delim) == 1:
+            ds = Job._encode_input_native(input_path, enc, delim, with_labels)
+            if ds is not None:
+                return enc, ds, None
+        rows = read_input(input_path, delim=delim)
         ds = enc.fit_transform(rows, with_labels=with_labels) if not enc._fitted \
             else enc.transform(rows, with_labels=with_labels)
         return enc, ds, rows
+
+    @staticmethod
+    def _encode_input_native(input_path: str, enc: DatasetEncoder,
+                             delim: str, with_labels: bool):
+        """EncodedDataset via the C++ data plane, or None if unavailable."""
+        from avenir_tpu.runtime import native
+
+        if not native.is_available() or \
+                not (enc._fitted or enc.schema_complete(with_labels)):
+            return None
+        parts = []
+        ncols = None
+        for f in input_files(input_path):
+            with open(f, "rb") as fh:
+                data = fh.read()
+            if not data.strip():
+                continue
+            if ncols is None:
+                # first NON-BLANK line (leading blank/CRLF lines are data
+                # the encoder itself skips)
+                first = next((ln for ln in data.split(b"\n")
+                              if ln.strip()), b"").rstrip(b"\r")
+                ncols = first.count(delim.encode()) + 1
+                if ncols <= enc.max_ordinal(with_labels):
+                    # narrower file than the schema consumes: the Python
+                    # path degrades gracefully (e.g. labels=None when the
+                    # class column is absent); never index C++ out of range
+                    return None
+            parts.append(native.encode_bytes(data, enc, ncols=ncols,
+                                             delim=delim,
+                                             with_labels=with_labels))
+        if not parts:
+            return None                      # empty input: python path decides
+        if len(parts) == 1:
+            return parts[0]
+        first = parts[0]
+        cat = lambda key: (None if getattr(first, key) is None else
+                           np.concatenate([getattr(p, key) for p in parts]))
+        return EncodedDataset(
+            codes=cat("codes"), cont=cat("cont"), labels=cat("labels"),
+            ids=cat("ids"), n_bins=first.n_bins,
+            class_values=first.class_values,
+            binned_ordinals=first.binned_ordinals,
+            cont_ordinals=first.cont_ordinals)
 
     @staticmethod
     def iter_encoded_retrying(conf: JobConfig, input_path: str,
@@ -178,11 +236,16 @@ class Job:
         the single-pass stream cannot assign stable codes, and
         ``DatasetEncoder.transform`` raises ConfigError (non-retryable)."""
         from avenir_tpu.core.csv_io import read_csv_string
+        from avenir_tpu.runtime import native
         from avenir_tpu.utils.retry import RetryPolicy, run_with_retry
 
         policy = RetryPolicy.from_conf(conf)
         chunk_rows = conf.get_int("stream.chunk.rows", 1_000_000)
         delim = conf.field_delim_regex
+        # an incomplete schema must still fail fast with ConfigError via the
+        # python transform, so the native path also gates on completeness
+        use_native = (native.is_available() and len(delim) == 1 and
+                      (encoder._fitted or encoder.schema_complete(with_labels)))
         i = 0
         for f in input_files(input_path):
             offset = 0
@@ -190,17 +253,22 @@ class Job:
                 def task(path=f, off=offset):
                     with open(path, "rb") as fh:
                         fh.seek(off)
-                        lines: List[str] = []
-                        while len(lines) < chunk_rows:
+                        raw: List[bytes] = []
+                        while len(raw) < chunk_rows:
                             ln = fh.readline()
                             if not ln:
                                 break
                             if ln.strip():
-                                lines.append(ln.decode())
+                                raw.append(ln)
                         end = fh.tell()
-                    if not lines:
+                    if not raw:
                         return end, None
-                    rows = read_csv_string("".join(lines), delim=delim)
+                    ncols = raw[0].rstrip(b"\r\n").count(delim.encode()) + 1
+                    if use_native and ncols > encoder.max_ordinal(with_labels):
+                        return end, native.encode_bytes(
+                            b"".join(raw), encoder, ncols=ncols, delim=delim,
+                            with_labels=with_labels)
+                    rows = read_csv_string(b"".join(raw).decode(), delim=delim)
                     return end, encoder.transform(rows, with_labels=with_labels)
 
                 offset, ds = run_with_retry(

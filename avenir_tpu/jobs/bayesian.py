@@ -44,7 +44,8 @@ class BayesianDistribution(Job):
 
             model = nbayes.fit(chunks())
         else:
-            enc, ds, _rows = self.encode_input(conf, input_path)
+            enc, ds, _rows = self.encode_input(conf, input_path,
+                                               need_rows=False)
             model = nbayes.fit(ds)
             n_rows = ds.num_rows
         lines = nb.model_to_lines(model, enc, delim=conf.field_delim)

@@ -35,7 +35,7 @@ class MutualInformation(Job):
                 counters: Counters) -> None:
         delim = conf.field_delim
         schema = self.load_schema(conf)
-        _enc, ds, _rows = self.encode_input(conf, input_path)
+        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
         names = [schema.field_by_ordinal(o).name for o in ds.binned_ordinals]
         result = mi.MutualInformation(mesh=self.auto_mesh(conf)).fit(
             ds, feature_names=names)
@@ -65,7 +65,7 @@ class _CorrelationJob(Job):
                 counters: Counters) -> None:
         delim = conf.field_delim
         schema = self.load_schema(conf)
-        _enc, ds, _rows = self.encode_input(conf, input_path)
+        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
         names = [schema.field_by_ordinal(o).name for o in ds.binned_ordinals]
         # source/dest attribute lists arrive as schema ordinals
         # (CramerCorrelation.java:95-100); map them to binned indices

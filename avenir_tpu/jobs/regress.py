@@ -34,7 +34,7 @@ class LogisticRegressionJob(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        _enc, ds, _rows = self.encode_input(conf, input_path)
+        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
         x = mlr.design_matrix(ds)
         y = np.asarray(ds.labels, np.float32)
         coeff_path = conf.get("coeff.file.path") or os.path.join(
@@ -83,7 +83,7 @@ class FisherDiscriminant(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        _enc, ds, _rows = self.encode_input(conf, input_path)
+        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
         schema = self.load_schema(conf)
         names = [schema.field_by_ordinal(o).name for o in ds.cont_ordinals]
         model = mfisher.FisherDiscriminant(mesh=self.auto_mesh(conf)).fit(ds)

@@ -55,7 +55,7 @@ class ClassPartitionGenerator(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        _enc, ds, _rows = self.encode_input(conf, input_path)
+        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
         p = _tree_params(conf)
         if conf.get_bool("at.root"):
             # phase-1 bootstrap of the reference's two-job tree runbook:
@@ -191,7 +191,7 @@ class DecisionTreeBuilder(Job):
         if conf.get("tree.model.file.path"):
             self._predict(conf, input_path, output_path, counters)
             return
-        enc, ds, _rows = self.encode_input(conf, input_path)
+        enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
         schema = self.load_schema(conf)
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]

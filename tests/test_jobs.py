@@ -362,11 +362,15 @@ def test_markov_jobs_ragged_sequences(tmp_path):
     assert decoded[0].count(",") == 4            # 2 id fields + 3 states
 
 
-def test_bayesian_streaming_train_matches_whole_and_retries(churn_env, monkeypatch):
+@pytest.mark.parametrize("path_kind", ["native", "python"])
+def test_bayesian_streaming_train_matches_whole_and_retries(
+        churn_env, monkeypatch, path_kind):
     # stream.chunk.rows gates the chunked read+encode train path: the model
     # file must be byte-identical to the whole-input path, and an injected
-    # transient encode fault must be absorbed by the task-retry policy
+    # transient encode fault must be absorbed by the task-retry policy —
+    # on BOTH the native chunk path and the Python fallback
     from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.runtime import native as nat
     from avenir_tpu.utils.retry import InjectedFault
 
     root, conf = churn_env
@@ -375,18 +379,32 @@ def test_bayesian_streaming_train_matches_whole_and_retries(churn_env, monkeypat
     sconf = JobConfig(dict(conf.props))
     sconf.set("stream.chunk.rows", "300")
 
-    orig = DatasetEncoder.transform
     state = {"n": 0}
+    if path_kind == "native":
+        assert nat.is_available()
+        orig = nat.encode_bytes
 
-    def flaky(self, rows, with_labels=True):
-        state["n"] += 1
-        if state["n"] == 3:            # one transient fault mid-stream
-            raise InjectedFault("encode worker died")
-        return orig(self, rows, with_labels=with_labels)
+        def flaky(*args, **kwargs):
+            state["n"] += 1
+            if state["n"] == 3:        # one transient fault mid-stream
+                raise InjectedFault("encode worker died")
+            return orig(*args, **kwargs)
 
-    monkeypatch.setattr(DatasetEncoder, "transform", flaky)
+        monkeypatch.setattr(nat, "encode_bytes", flaky)
+    else:
+        monkeypatch.setattr(nat, "is_available", lambda: False)
+        orig_t = DatasetEncoder.transform
+
+        def flaky_t(self, rows, with_labels=True):
+            state["n"] += 1
+            if state["n"] == 3:
+                raise InjectedFault("encode worker died")
+            return orig_t(self, rows, with_labels=with_labels)
+
+        monkeypatch.setattr(DatasetEncoder, "transform", flaky_t)
     c = get_job("BayesianDistribution").run(sconf, str(root / "train.csv"),
                                             str(root / "model_stream"))
+    assert state["n"] >= 3             # the fault actually fired
     assert read_lines(str(root / "model_stream")) == \
         read_lines(str(root / "model_whole"))
     assert c.get("Records", "Processed") == 1600
@@ -442,3 +460,50 @@ def test_auto_mesh_gaussian_moments_agree(elearn_env, tmp_path):
         for xa, xb in zip(fa, fb):
             if xa != xb:
                 np.testing.assert_allclose(float(xa), float(xb), rtol=1e-5)
+
+
+def test_native_job_ingest_matches_python_path(churn_env, monkeypatch):
+    # train/analyze jobs (need_rows=False) ingest via the C++ data plane
+    # when the schema is complete; output must be byte-identical to the
+    # pure-Python encode path
+    from avenir_tpu.jobs.base import Job
+    from avenir_tpu.runtime import native
+
+    root, conf = churn_env
+    assert native.is_available()
+    enc = Job.encoder_for(conf)
+    assert enc.schema_complete(True)       # churn schema is self-describing
+    ds = Job._encode_input_native(str(root / "train.csv"), enc, ",", True)
+    assert ds is not None and ds.num_rows == 1600
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(root / "model_nat"))
+    monkeypatch.setattr(Job, "_encode_input_native",
+                        staticmethod(lambda *a, **k: None))
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(root / "model_py"))
+    assert read_lines(str(root / "model_nat")) == \
+        read_lines(str(root / "model_py"))
+
+
+def test_native_ingest_guards_narrow_and_blank_leading_input(churn_env, tmp_path):
+    # a file narrower than the schema consumes must fall back to the Python
+    # path (graceful labels=None -> clear error), never index C++ out of
+    # range; a leading blank line must not poison the ncols sniff
+    from avenir_tpu.jobs.base import Job
+
+    root, conf = churn_env
+    enc = Job.encoder_for(conf)
+    # strip the class column (ordinal 6) from every row
+    narrow = tmp_path / "narrow.csv"
+    with open(root / "train.csv") as fh:
+        rows = [ln.rstrip("\n").rsplit(",", 1)[0] for ln in fh if ln.strip()]
+    narrow.write_text("\n".join(rows) + "\n")
+    assert Job._encode_input_native(str(narrow), enc, ",", True) is None
+    with pytest.raises(ValueError):
+        get_job("BayesianDistribution").run(conf, str(narrow),
+                                            str(tmp_path / "m1"))
+    # leading blank + CRLF lines: sniff skips them, native path still engages
+    blanky = tmp_path / "blanky.csv"
+    blanky.write_bytes(b"\n\r\n" + (root / "train.csv").read_bytes())
+    ds = Job._encode_input_native(str(blanky), enc, ",", True)
+    assert ds is not None and ds.num_rows == 1600
