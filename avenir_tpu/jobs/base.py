@@ -170,15 +170,51 @@ class Job:
         return enc, ds, rows
 
     @staticmethod
+    def encode_input_with_lines(conf: JobConfig, input_path: str,
+                                with_labels: bool = True,
+                                encoder: Optional[DatasetEncoder] = None):
+        """(encoder, encoded dataset, raw input lines) for scoring jobs that
+        echo each input line into their output (line ``i`` corresponds to
+        dataset row ``i``; blank lines are skipped on both sides). Uses the
+        native encode path under the same conditions as
+        ``encode_input(need_rows=False)``; the Python fallback reconstructs
+        lines from the parsed fields (identical text for well-formed CSV)."""
+        delim = conf.field_delim_regex
+        enc = encoder or Job.encoder_for(conf)
+        # echoing raw lines is only equivalent to rejoining parsed fields
+        # when the input and output delimiters agree (they are independent
+        # reference properties); otherwise the Python path rejoins uniformly
+        if len(delim) == 1 and delim == conf.field_delim:
+            got = Job._encode_input_native(input_path, enc, delim,
+                                           with_labels, want_lines=True)
+            if got is not None:
+                ds, lines = got
+                if lines is not None and len(lines) == ds.num_rows:
+                    return enc, ds, lines
+                # alignment/decode surprise: fall through to Python
+        enc2, ds, rows = Job.encode_input(conf, input_path,
+                                          with_labels=with_labels, encoder=enc)
+        return enc2, ds, [conf.field_delim.join(str(v) for v in row)
+                          for row in rows]
+
+    @staticmethod
     def _encode_input_native(input_path: str, enc: DatasetEncoder,
-                             delim: str, with_labels: bool):
-        """EncodedDataset via the C++ data plane, or None if unavailable."""
+                             delim: str, with_labels: bool,
+                             want_lines: bool = False):
+        """EncodedDataset via the C++ data plane, or None if unavailable.
+
+        With ``want_lines`` returns ``(dataset, lines)`` where ``lines`` are
+        the raw non-blank input lines derived from the SAME bytes the
+        encoder parsed (one read per file), or ``lines=None`` when the
+        bytes don't decode as UTF-8 (caller falls back to the Python path,
+        which reads with the locale encoding)."""
         from avenir_tpu.runtime import native
 
         if not native.is_available() or \
                 not (enc._fitted or enc.schema_complete(with_labels)):
             return None
         parts = []
+        lines: Optional[List[str]] = [] if want_lines else None
         ncols = None
         for f in input_files(input_path):
             with open(f, "rb") as fh:
@@ -199,19 +235,27 @@ class Job:
             parts.append(native.encode_bytes(data, enc, ncols=ncols,
                                              delim=delim,
                                              with_labels=with_labels))
+            if lines is not None:
+                try:
+                    lines.extend(ln.decode().rstrip("\r")
+                                 for ln in data.split(b"\n") if ln.strip())
+                except UnicodeDecodeError:
+                    lines = None
         if not parts:
             return None                      # empty input: python path decides
         if len(parts) == 1:
-            return parts[0]
-        first = parts[0]
-        cat = lambda key: (None if getattr(first, key) is None else
-                           np.concatenate([getattr(p, key) for p in parts]))
-        return EncodedDataset(
-            codes=cat("codes"), cont=cat("cont"), labels=cat("labels"),
-            ids=cat("ids"), n_bins=first.n_bins,
-            class_values=first.class_values,
-            binned_ordinals=first.binned_ordinals,
-            cont_ordinals=first.cont_ordinals)
+            ds = parts[0]
+        else:
+            first = parts[0]
+            cat = lambda key: (None if getattr(first, key) is None else
+                               np.concatenate([getattr(p, key) for p in parts]))
+            ds = EncodedDataset(
+                codes=cat("codes"), cont=cat("cont"), labels=cat("labels"),
+                ids=cat("ids"), n_bins=first.n_bins,
+                class_values=first.class_values,
+                binned_ordinals=first.binned_ordinals,
+                cont_ordinals=first.cont_ordinals)
+        return (ds, lines) if want_lines else ds
 
     @staticmethod
     def iter_encoded_retrying(conf: JobConfig, input_path: str,

@@ -166,7 +166,14 @@ class BayesianPredictor(Job):
             self._predict_text(conf, input_path, output_path, counters)
             return
         validate = conf.get("prediction.mode", "prediction") == "validation"
-        enc, ds, rows = self.encode_input(conf, input_path, with_labels=validate)
+        prob_only = conf.get_bool("output.feature.prob.only")
+        if prob_only:                      # no echo: skip line collection
+            enc, ds, _rows = self.encode_input(
+                conf, input_path, with_labels=validate, need_rows=False)
+            in_lines = None
+        else:
+            enc, ds, in_lines = self.encode_input_with_lines(
+                conf, input_path, with_labels=validate)
         model = nb.model_from_lines(read_lines(model_path), enc, delim=delim)
 
         threshold = conf.get_float("class.prob.diff.threshold")
@@ -179,7 +186,7 @@ class BayesianPredictor(Job):
             validate=validate, pos_class=conf.get("positive.class.value"))
 
         out: List[str] = []
-        if conf.get_bool("output.feature.prob.only"):
+        if prob_only:
             # (id or row-index, classVal, posterior) rows for the kNN joiner
             ids = ds.ids if ds.ids is not None else np.arange(ds.num_rows)
             for i in range(ds.num_rows):
@@ -188,11 +195,11 @@ class BayesianPredictor(Job):
                         [str(ids[i]), cv, f"{result.probs[i, ci]:.6f}"]))
         else:
             amb = result.ambiguous
-            for i, row in enumerate(rows):
-                items = list(row) + [model.class_values[int(result.predicted[i])]]
+            for i, line in enumerate(in_lines):
+                items = [line, model.class_values[int(result.predicted[i])]]
                 if amb is not None and bool(amb[i]):
                     items.append("ambiguous")
-                out.append(delim.join(str(v) for v in items))
+                out.append(delim.join(items))
         write_output(output_path, out)
         counters.set("Records", "Processed", ds.num_rows)
         if result.counters is not None:

@@ -29,11 +29,12 @@ from avenir_tpu.models import naive_bayes as nb
 from avenir_tpu.utils.metrics import Counters
 
 
-def _train_model(conf: JobConfig, enc=None):
+def _train_model(conf: JobConfig, enc=None, need_rows: bool = True):
     train_path = conf.get("training.data.path")
     if not train_path:
         raise ValueError("training.data.path not set")
-    return Job.encode_input(conf, train_path, encoder=enc)
+    return Job.encode_input(conf, train_path, encoder=enc,
+                            need_rows=need_rows)
 
 
 class SameTypeSimilarity(Job):
@@ -46,9 +47,10 @@ class SameTypeSimilarity(Job):
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
         delim = conf.field_delim
-        enc, train_ds, train_rows = _train_model(conf)
-        _enc, test_ds, test_rows = self.encode_input(
-            conf, input_path, with_labels=False, encoder=enc)
+        enc, train_ds, _train_rows = _train_model(conf, need_rows=False)
+        _enc, test_ds, _test_rows = self.encode_input(
+            conf, input_path, with_labels=False, encoder=enc,
+            need_rows=False)
         model = mknn.fit_knn(train_ds)
         k = conf.get_int("top.match.count", 10)
         ids = (test_ds.ids if test_ds.ids is not None
@@ -108,11 +110,17 @@ class NearestNeighbor(Job):
                 counters: Counters) -> None:
         from avenir_tpu.jobs.bayesian import _cost_matrix
         delim = conf.field_delim
-        enc, train_ds, train_rows = _train_model(conf)
         regression = conf.get("prediction.mode") == "regression"
         validate = conf.get_bool("validation.mode", False)
-        _e, test_ds, test_rows = self.encode_input(
-            conf, input_path, with_labels=validate and not regression, encoder=enc)
+        enc, train_ds, train_rows = _train_model(conf, need_rows=regression)
+        if regression:
+            _e, test_ds, test_rows = self.encode_input(
+                conf, input_path, with_labels=False, encoder=enc)
+            test_lines = None
+        else:
+            _e, test_ds, test_lines = self.encode_input_with_lines(
+                conf, input_path, with_labels=validate, encoder=enc)
+            test_rows = None
 
         class_cond = (conf.get_bool("class.condition.weighted", False)
                       or conf.get_bool("class.condtion.weighted", False))
@@ -162,9 +170,9 @@ class NearestNeighbor(Job):
         else:
             model = est.fit(train_ds, class_probs=class_probs)
             result = est.predict(model, test_ds, validate=validate)
-            for i, row in enumerate(test_rows):
+            for i, line in enumerate(test_lines):
                 out.append(delim.join(
-                    list(row) + [train_ds.class_values[int(result.predicted[i])]]))
+                    [line, train_ds.class_values[int(result.predicted[i])]]))
             if result.counters is not None:
                 counters.merge(result.counters)
         write_output(output_path, out)
