@@ -92,6 +92,19 @@ class EncodedDataset:
         )
 
 
+def peek_chunks(data):
+    """(meta, lazy chunk iterable) for the Union[EncodedDataset,
+    Iterable[EncodedDataset]] fit contract: peek the first chunk for shape
+    metadata without materializing the stream; raises on empty input."""
+    import itertools
+
+    it = iter([data] if isinstance(data, EncodedDataset) else data)
+    meta = next(it, None)
+    if meta is None:
+        raise ValueError("no data")
+    return meta, itertools.chain([meta], it)
+
+
 class DatasetEncoder:
     """Schema-driven encoder with a fitted closed vocabulary.
 

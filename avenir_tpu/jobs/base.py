@@ -257,6 +257,33 @@ class Job:
                 cont_ordinals=first.cont_ordinals)
         return (ds, lines) if want_lines else ds
 
+    def encoded_data_source(self, conf: JobConfig, input_path: str,
+                            counters: Counters, with_labels: bool = True):
+        """(encoder, data, rows_fn) for count-aggregation jobs whose model
+        ``fit`` accepts either one EncodedDataset or a chunk iterable.
+
+        With ``stream.chunk.rows`` set, ``data`` is the lazy retried chunk
+        stream (:meth:`iter_encoded_retrying`) so arbitrarily large inputs
+        never materialize whole; otherwise it is the whole encoded input
+        (native path when eligible). ``rows_fn()`` reports rows processed —
+        call it only after ``fit`` has consumed the stream."""
+        if conf.get("stream.chunk.rows"):
+            enc = self.encoder_for(conf)
+            box = {"n": 0}
+
+            def chunks():
+                for d in self.iter_encoded_retrying(
+                        conf, input_path, enc, counters,
+                        with_labels=with_labels):
+                    box["n"] += d.num_rows
+                    yield d
+
+            return enc, chunks(), lambda: box["n"]
+        enc, ds, _rows = self.encode_input(conf, input_path,
+                                           with_labels=with_labels,
+                                           need_rows=False)
+        return enc, ds, lambda: ds.num_rows
+
     @staticmethod
     def iter_encoded_retrying(conf: JobConfig, input_path: str,
                               encoder: DatasetEncoder,

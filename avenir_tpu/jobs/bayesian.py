@@ -28,29 +28,13 @@ class BayesianDistribution(Job):
             return
         nbayes = nb.NaiveBayes(laplace=conf.get_float("laplace.smoothing", 1.0),
                                mesh=self.auto_mesh(conf))
-        if conf.get("stream.chunk.rows"):
-            # streaming train: chunked read+encode under the task-retry
-            # policy, counts accumulated chunk-by-chunk on device (needs a
-            # schema-complete encoder; see Job.iter_encoded_retrying)
-            enc = self.encoder_for(conf)
-            n_rows = 0
-
-            def chunks():
-                nonlocal n_rows
-                for ds in self.iter_encoded_retrying(
-                        conf, input_path, enc, counters):
-                    n_rows += ds.num_rows
-                    yield ds
-
-            model = nbayes.fit(chunks())
-        else:
-            enc, ds, _rows = self.encode_input(conf, input_path,
-                                               need_rows=False)
-            model = nbayes.fit(ds)
-            n_rows = ds.num_rows
+        # stream.chunk.rows switches to the chunked read+encode stream under
+        # the task-retry policy (needs a schema-complete encoder)
+        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters)
+        model = nbayes.fit(data)
         lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
         write_output(output_path, lines)
-        counters.set("Records", "Processed", n_rows)
+        counters.set("Records", "Processed", rows_fn())
         counters.set("Model", "Rows", len(lines))
 
     def _execute_text(self, conf: JobConfig, input_path: str, output_path: str,

@@ -35,10 +35,11 @@ class MutualInformation(Job):
                 counters: Counters) -> None:
         delim = conf.field_delim
         schema = self.load_schema(conf)
-        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
-        names = [schema.field_by_ordinal(o).name for o in ds.binned_ordinals]
+        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters)
+        names = [schema.field_by_ordinal(f.ordinal).name
+                 for f in enc.binned_fields]
         result = mi.MutualInformation(mesh=self.auto_mesh(conf)).fit(
-            ds, feature_names=names)
+            data, feature_names=names)
         lines: List[str] = []
         if conf.get_bool("output.mutual.info", True):
             lines.extend(result.to_lines(delim=delim))
@@ -52,7 +53,7 @@ class MutualInformation(Job):
             lines.extend(
                 delim.join([names[f], f"{score:.6f}"]) for f, score in ranked)
         write_output(output_path, lines)
-        counters.set("Records", "Processed", ds.num_rows)
+        counters.set("Records", "Processed", rows_fn())
 
 
 class _CorrelationJob(Job):
@@ -65,11 +66,12 @@ class _CorrelationJob(Job):
                 counters: Counters) -> None:
         delim = conf.field_delim
         schema = self.load_schema(conf)
-        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
-        names = [schema.field_by_ordinal(o).name for o in ds.binned_ordinals]
+        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters)
+        binned_ords = [f.ordinal for f in enc.binned_fields]
+        names = [schema.field_by_ordinal(o).name for o in binned_ords]
         # source/dest attribute lists arrive as schema ordinals
         # (CramerCorrelation.java:95-100); map them to binned indices
-        ord_to_idx = {o: i for i, o in enumerate(ds.binned_ordinals)}
+        ord_to_idx = {o: i for i, o in enumerate(binned_ords)}
         src = conf.get_int_list("source.attributes")
         dst = conf.get_int_list("dest.attributes")
         class_ord = schema.class_field.ordinal if schema.class_field else None
@@ -77,7 +79,7 @@ class _CorrelationJob(Job):
         job = corr.CategoricalCorrelation(algorithm=self._algorithm(conf),
                                           mesh=self.auto_mesh(conf))
         result = job.fit(
-            ds,
+            data,
             src=[ord_to_idx[o] for o in src] if src else None,
             dst=(None if against_class or dst is None
                  else [ord_to_idx[o] for o in dst]),
@@ -85,7 +87,7 @@ class _CorrelationJob(Job):
             feature_names=names,
         )
         write_output(output_path, result.to_lines(delim=delim))
-        counters.set("Records", "Processed", ds.num_rows)
+        counters.set("Records", "Processed", rows_fn())
 
 
 class CramerCorrelation(_CorrelationJob):

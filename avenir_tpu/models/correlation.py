@@ -22,7 +22,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Un
 import jax.numpy as jnp
 import numpy as np
 
-from avenir_tpu.core.encoding import EncodedDataset
+from avenir_tpu.core.encoding import EncodedDataset, peek_chunks
 from avenir_tpu.ops import agg, info
 
 STATS: Dict[str, Callable] = {
@@ -74,10 +74,7 @@ class CategoricalCorrelation:
         against_class: bool = False,
         feature_names: Optional[Sequence[str]] = None,
     ) -> CorrelationResult:
-        chunks = [data] if isinstance(data, EncodedDataset) else list(data)
-        if not chunks:
-            raise ValueError("no data")
-        meta = chunks[0]
+        meta, chunks = peek_chunks(data)           # lazy: stream-friendly
         f, b = meta.num_binned, meta.max_bins
         names = list(feature_names) if feature_names is not None else [
             f"f{o}" for o in meta.binned_ordinals]

@@ -27,7 +27,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
-from avenir_tpu.core.encoding import EncodedDataset
+from avenir_tpu.core.encoding import EncodedDataset, peek_chunks
 from avenir_tpu.ops import agg, info
 
 
@@ -129,10 +129,7 @@ class MutualInformation:
 
     def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]],
             feature_names: Optional[Sequence[str]] = None) -> MutualInfoResult:
-        chunks = [data] if isinstance(data, EncodedDataset) else list(data)
-        if not chunks:
-            raise ValueError("no data")
-        meta = chunks[0]
+        meta, chunks = peek_chunks(data)           # lazy: stream-friendly
         if meta.labels is None:
             raise ValueError("mutual information requires a class attribute")
         f, b, c = meta.num_binned, meta.max_bins, meta.num_classes

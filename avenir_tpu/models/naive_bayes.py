@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset, peek_chunks
 from avenir_tpu.ops import agg
 from avenir_tpu.utils.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
 
@@ -170,9 +170,8 @@ class NaiveBayes:
         return maybe_shard_batch(self.mesh, *arrays)
 
     def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]]) -> NaiveBayesModel:
-        chunks = [data] if isinstance(data, EncodedDataset) else data
+        meta, chunks = peek_chunks(data)
         acc = agg.Accumulator()
-        meta: Optional[EncodedDataset] = None
         for ds in chunks:
             meta = ds
             if ds.labels is None:
@@ -187,8 +186,6 @@ class NaiveBayes:
                 acc.add("cont_count", cnt)
                 acc.add("cont_sum", s1)
                 acc.add("cont_sumsq", s2)
-        if meta is None:
-            raise ValueError("no data")
         f, bmax, cnum = meta.num_binned, meta.max_bins, meta.num_classes
         return NaiveBayesModel(
             class_values=list(meta.class_values),

@@ -507,3 +507,25 @@ def test_native_ingest_guards_narrow_and_blank_leading_input(churn_env, tmp_path
     blanky.write_bytes(b"\n\r\n" + (root / "train.csv").read_bytes())
     ds = Job._encode_input_native(str(blanky), enc, ",", True)
     assert ds is not None and ds.num_rows == 1600
+
+
+def test_streaming_mi_and_cramer_match_whole(churn_env):
+    # the north-star pipeline's other half: MutualInformation (and the
+    # Cramer job) accept stream.chunk.rows, consuming retried encode chunks
+    # lazily with identical output to the whole-input path
+    root, conf = churn_env
+    for job, out, extra in [("MutualInformation", "mi", {}),
+                            ("CramerCorrelation", "cram",
+                             {"dest.attributes": "6"})]:
+        base = JobConfig(dict(conf.props))
+        for k, v in extra.items():
+            base.set(k, v)
+        get_job(job).run(base, str(root / "train.csv"), str(root / f"{out}_w"))
+        sconf = JobConfig(dict(base.props))
+        sconf.set("stream.chunk.rows", "300")
+        c = get_job(job).run(sconf, str(root / "train.csv"),
+                             str(root / f"{out}_s"))
+        assert read_lines(str(root / f"{out}_s")) == \
+            read_lines(str(root / f"{out}_w"))
+        assert c.get("Records", "Processed") == 1600
+        assert c.get("Task", "attempts") >= 6
