@@ -87,7 +87,7 @@ def read_lines(path: str) -> List[str]:
     out: List[str] = []
     for f in input_files(path):
         with open(f) as fh:
-            out.extend(line.rstrip("\n") for line in fh if line.strip())
+            out.extend(line.rstrip("\r\n") for line in fh if line.strip())
     return out
 
 

@@ -12,7 +12,7 @@ import jax
 import numpy as np
 
 from avenir_tpu.core.config import JobConfig
-from avenir_tpu.jobs.base import Job, read_input, write_output
+from avenir_tpu.jobs.base import Job, write_output
 from avenir_tpu.models import correlation as corr
 from avenir_tpu.models import mutual_info as mi
 from avenir_tpu.models import samplers
@@ -114,18 +114,21 @@ class BaggingSampler(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        delim = conf.field_delim_regex
-        rows = read_input(input_path, delim=delim)
+        # pure row-level resampling: fields are never inspected, so read raw
+        # lines (no CSV parse, no schema needed) and emit them verbatim
+        from avenir_tpu.jobs.base import read_lines
+
+        lines = read_lines(input_path)
         batch = conf.get_int("batch.size", 10_000)
         key = jax.random.PRNGKey(conf.get_int("seed", 0))
         out: List[str] = []
-        for s in range(0, rows.shape[0], batch):
-            chunk = rows[s:s + batch]
+        for s in range(0, len(lines), batch):
+            chunk = lines[s:s + batch]
             key, sub = jax.random.split(key)
-            idx = np.asarray(samplers.bootstrap_indices(sub, chunk.shape[0]))
-            out.extend(delim.join(chunk[i]) for i in idx)
+            idx = np.asarray(samplers.bootstrap_indices(sub, len(chunk)))
+            out.extend(chunk[i] for i in idx)
         write_output(output_path, out)
-        counters.set("Records", "Processed", int(rows.shape[0]))
+        counters.set("Records", "Processed", len(lines))
         counters.set("Records", "Emitted", len(out))
 
 
@@ -137,19 +140,30 @@ class UnderSamplingBalancer(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        delim = conf.field_delim_regex
-        schema = self.load_schema(conf)
-        rows = read_input(input_path, delim=delim)
-        class_ord = schema.class_field.ordinal
-        labels_raw = rows[:, class_ord]
-        values, inverse, cts = np.unique(
-            labels_raw.astype(str), return_inverse=True, return_counts=True)
         import jax.numpy as jnp
+
+        from avenir_tpu.jobs.base import read_lines
+
+        # only the class column is inspected: read raw lines and slice the
+        # class field per row — feature columns are never parsed, so data
+        # the downstream jobs would reject (sentinels in numeric columns,
+        # class values outside a declared cardinality) still samples fine,
+        # exactly as the reference's mapper behaved
+        schema = self.load_schema(conf)
+        if schema.class_field is None:
+            raise ValueError("undersampling requires a class attribute")
+        class_ord = schema.class_field.ordinal
+        delim = conf.field_delim_regex
+        lines = read_lines(input_path)
+        labels_raw = [ln.split(delim)[class_ord] for ln in lines]
+        _values, inverse, cts = np.unique(
+            np.asarray(labels_raw, dtype=object).astype(str),
+            return_inverse=True, return_counts=True)
         key = jax.random.PRNGKey(conf.get_int("seed", 0))
         mask = np.asarray(samplers.undersample_mask(
             key, jnp.asarray(inverse.astype(np.int32)),
             jnp.asarray(cts.astype(np.float32))))
-        out = [delim.join(rows[i]) for i in np.nonzero(mask)[0]]
+        out = [lines[i] for i in np.nonzero(mask)[0]]
         write_output(output_path, out)
-        counters.set("Records", "Processed", int(rows.shape[0]))
+        counters.set("Records", "Processed", len(lines))
         counters.set("Records", "Emitted", len(out))
