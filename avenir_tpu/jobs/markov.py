@@ -107,7 +107,7 @@ class ViterbiStatePredictor(Job):
         model = mk.HMMModel.from_lines(read_lines(model_path),
                                        delim=conf.field_delim)
         pair_output = not conf.get_bool("output.state.only", True)
-        predictor = mk.ViterbiStatePredictor(model, pair_output=pair_output,
+        predictor = mk.ViterbiStatePredictor(model, mesh=self.auto_mesh(conf), pair_output=pair_output,
                                              delim=conf.field_delim)
         skip = conf.get_int("skip.field.count", 1)
         rows = [[conf.field_delim.join(r[:skip])] + list(r[skip:])

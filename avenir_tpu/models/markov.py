@@ -487,11 +487,12 @@ class ViterbiDecoder:
     work — for long sequences). :func:`viterbi_time_sharded` additionally
     shards one sequence's time axis over a device mesh."""
 
-    def __init__(self, model: HMMModel, method: str = "scan"):
+    def __init__(self, model: HMMModel, method: str = "scan", mesh=None):
         if method not in ("scan", "assoc"):
             raise ValueError(f"unknown viterbi method {method!r}")
         self.model = model
         self.method = method
+        self.mesh = mesh          # optional data mesh: records shard over it
         eps = 1e-12
         self._log_a = jnp.asarray(np.log(np.maximum(model.transition, eps)), jnp.float32)
         self._log_b = jnp.asarray(np.log(np.maximum(model.emission, eps)), jnp.float32)
@@ -499,10 +500,19 @@ class ViterbiDecoder:
         self._obs_map = {o: i for i, o in enumerate(model.observations)}
 
     def decode_codes(self, obs: np.ndarray) -> np.ndarray:
-        """[R, T] obs codes (−1 pad) → [R, T] state codes (−1 pad)."""
+        """[R, T] obs codes (−1 pad) → [R, T] state codes (−1 pad).
+
+        Under a data mesh the record axis shards across devices (all-−1 pad
+        rows decode to all-−1 and are trimmed) — the map-only prediction
+        job's record parallelism."""
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+
         fn = _viterbi_batch if self.method == "scan" else _viterbi_assoc_batch
+        obs = np.asarray(obs, np.int32)
+        n = obs.shape[0]
+        obs_b = maybe_shard_batch(self.mesh, obs)[0]
         return np.asarray(fn(self._log_a, self._log_b, self._log_pi,
-                             jnp.asarray(obs, jnp.int32)))
+                             obs_b))[:n]
 
     def decode(self, obs_seqs: Sequence[Sequence[str]]) -> List[List[str]]:
         t = max((len(s) for s in obs_seqs), default=0)
@@ -518,8 +528,9 @@ class ViterbiStatePredictor:
     """The map-only prediction job: rows of (id, obs...) → decoded states
     (ViterbiStatePredictor.java:114-142; ``obs:state`` pair output mode)."""
 
-    def __init__(self, model: HMMModel, pair_output: bool = False, delim: str = DELIM):
-        self.decoder = ViterbiDecoder(model)
+    def __init__(self, model: HMMModel, pair_output: bool = False,
+                 delim: str = DELIM, mesh=None):
+        self.decoder = ViterbiDecoder(model, mesh=mesh)
         self.pair_output = pair_output
         self.delim = delim
 

@@ -197,3 +197,22 @@ def test_viterbi_time_sharded_matches_sequential(rng):
                        + sum(float(la[p[i-1], p[i]] + lb[p[i], obs[i]])
                              for i in range(1, t)))
     assert score(path_sharded) == pytest.approx(score(path_seq), abs=1e-3)
+
+
+def test_viterbi_decode_meshed_matches_single(rng):
+    # record-axis sharding for the map-only decode job: 13 records on an
+    # 8-device mesh (pads engage), paths identical to single-device
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    s_states, vocab, t = 3, 4, 9
+    a = rng.dirichlet(np.ones(s_states), size=s_states)
+    b = rng.dirichlet(np.ones(vocab), size=s_states)
+    pi = rng.dirichlet(np.ones(s_states))
+    model = mk.HMMModel(states=["x", "y", "z"],
+                        observations=[str(i) for i in range(vocab)],
+                        transition=a, emission=b, initial=pi)
+    obs = rng.integers(0, vocab, size=(13, t)).astype(np.int32)
+    obs[3, 6:] = -1                      # one ragged row
+    single = mk.ViterbiDecoder(model).decode_codes(obs)
+    meshed = mk.ViterbiDecoder(model, mesh=make_mesh(("data",))).decode_codes(obs)
+    np.testing.assert_array_equal(meshed, single)
