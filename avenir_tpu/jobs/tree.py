@@ -139,7 +139,6 @@ class DataPartitioner(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        delim = conf.field_delim_regex
         splits_path = conf.get("split.file.path") or os.path.join(
             os.path.dirname(input_path.rstrip(os.sep)), "splits")
         rows_split = [ln.split(";") for ln in read_lines(splits_path)
@@ -153,7 +152,7 @@ class DataPartitioner(Job):
             scored[int(rng.integers(min(top_n, len(scored))))]
         _score, attr_ord, key = pick
 
-        enc, ds, rows = self.encode_input(conf, input_path)
+        enc, ds, lines = self.encode_input_with_lines(conf, input_path)
         schema = self.load_schema(conf)
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]
@@ -170,7 +169,7 @@ class DataPartitioner(Job):
             os.makedirs(seg_dir, exist_ok=True)
             with open(os.path.join(seg_dir, "partition.txt"), "w") as fh:
                 for i in np.nonzero(segs == g)[0]:
-                    fh.write(delim.join(rows[i]))
+                    fh.write(lines[i])
                     fh.write("\n")
         counters.set("Records", "Processed", ds.num_rows)
         counters.set("Splits", "Segments", int(sp.num_segments))

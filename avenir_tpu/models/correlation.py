@@ -106,13 +106,15 @@ class CategoricalCorrelation:
                 acc.add(f"c{s}", agg.pair_counts(ci, cj, b_dst))
         cont = (np.concatenate([acc.get(f"c{s}") for s in range(0, len(pairs), self.pair_chunk)])
                 if pairs else np.zeros((0, b_dst, b_dst), np.int64))
-        # statistic over the true (rows, cols) support of each pair
+        # statistic over the true (rows, cols) support of each pair; tiny
+        # tensors — keep the per-pair ops on the local CPU backend
         stat = np.zeros(len(pairs))
         stat_fn = STATS[self.algorithm]
-        for k, (i, j) in enumerate(pairs):
-            rows = int(meta.n_bins[i])
-            cols = int(meta.num_classes) if j < 0 else int(meta.n_bins[j])
-            stat[k] = float(stat_fn(jnp.asarray(cont[k, :rows, :cols], jnp.float32)))
+        with info.on_host():
+            for k, (i, j) in enumerate(pairs):
+                rows = int(meta.n_bins[i])
+                cols = int(meta.num_classes) if j < 0 else int(meta.n_bins[j])
+                stat[k] = float(stat_fn(jnp.asarray(cont[k, :rows, :cols], jnp.float32)))
         return CorrelationResult(
             pairs=pairs, pair_names=pair_names, stat=stat,
             algorithm=self.algorithm, contingency=cont,

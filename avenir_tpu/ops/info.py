@@ -16,10 +16,28 @@ nodes) for free.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
 _EPS = 1e-12
+
+
+def on_host():
+    """Context manager placing jnp ops on the local CPU backend.
+
+    The statistics in this module run over tiny count tensors (thousands of
+    elements); when the default device is a remote TPU each jnp primitive
+    pays a ~60 ms dispatch round-trip, so a ``finish()`` pass of ~100 small
+    ops costs seconds while the math itself is microseconds. Wrapping the
+    derived-statistics phase in ``with info.on_host():`` keeps it on the
+    local CPU. No-op when no CPU backend is registered."""
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:                   # pragma: no cover
+        return contextlib.nullcontext()
+    return jax.default_device(cpu)
 
 
 def _safe_log(x: jax.Array) -> jax.Array:
