@@ -68,7 +68,14 @@ def main(argv: List[str]) -> int:
     if cache_dir:
         try:
             import jax
-            cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+            # partition by backend: against a remote-compile tunnel even
+            # CPU-backend kernels are compiled with the SERVICE host's CPU
+            # features, and loading those executables on the local CPU can
+            # SIGILL — keeping per-backend subdirectories means purely-local
+            # runs never load remotely-compiled artifacts
+            cache_dir = os.path.join(
+                os.path.abspath(os.path.expanduser(cache_dir)),
+                jax.default_backend())
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

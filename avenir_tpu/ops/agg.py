@@ -93,6 +93,16 @@ def pair_counts(
     return jnp.einsum("npa,npb->pab", oh_i, oh_j, precision="highest").astype(jnp.int32)
 
 
+def nb_mi_pipeline_step(codes, labels, ci, cj, num_classes: int, num_bins: int):
+    """The benchmark-defining NB+MI aggregation step: class-conditional bin
+    counts plus all feature-pair-class joint counts in one dispatch pair.
+    Shared by bench.py and benchmarks/e2e_pipeline.py so the primary and
+    end-to-end metrics always measure identical work."""
+    return (feature_class_counts(codes, labels, num_classes, num_bins),
+            pair_class_counts(codes[:, ci], codes[:, cj], labels,
+                              num_classes, num_bins))
+
+
 @functools.partial(jax.jit, static_argnames=("num_classes", "num_bins"))
 def pair_class_counts(
     codes_i: jax.Array, codes_j: jax.Array, labels: jax.Array,

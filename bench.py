@@ -62,9 +62,7 @@ def main():
     dlabels = jnp.asarray(labels)
 
     def pipeline_step(c, l):
-        fbc = agg.feature_class_counts(c, l, n_classes, n_bins)
-        pc = agg.pair_class_counts(c[:, ci], c[:, cj], l, n_classes, n_bins)
-        return fbc, pc
+        return agg.nb_mi_pipeline_step(c, l, ci, cj, n_classes, n_bins)
 
     # warmup/compile
     out = pipeline_step(dcodes, dlabels)

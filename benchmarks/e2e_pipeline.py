@@ -34,7 +34,7 @@ def make_csv_block(n_rows: int, seed: int) -> bytes:
 
 def main():
     n_target = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000_000
-    block_rows = 500_000
+    block_rows = min(500_000, max(n_target, 1))   # honor small requests
     block = make_csv_block(block_rows, seed=1)      # one synthesized block,
     n_blocks = max(n_target // block_rows, 1)       # streamed n_blocks times
 
@@ -52,9 +52,7 @@ def main():
     ci, cj = pair_idx[:, 0], pair_idx[:, 1]
 
     def device_step(codes, labels):
-        return (agg.feature_class_counts(codes, labels, n_classes, nb),
-                agg.pair_class_counts(codes[:, ci], codes[:, cj], labels,
-                                      n_classes, nb))
+        return agg.nb_mi_pipeline_step(codes, labels, ci, cj, n_classes, nb)
 
     # warm up compile + native path
     d = native.encode_bytes(block, enc, ncols=ncols)
@@ -69,13 +67,17 @@ def main():
         ingest_dt = min(ingest_dt, time.perf_counter() - t0)
 
     # end-to-end: encode each block on host, dispatch async to device;
-    # device work of block i overlaps host encode of block i+1
-    t0 = time.perf_counter()
-    for _ in range(n_blocks):
-        d = native.encode_bytes(block, enc, ncols=ncols)
-        out = device_step(jnp.asarray(d.codes), jnp.asarray(d.labels))
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+    # device work of block i overlaps host encode of block i+1.
+    # Best of 3 passes, matching the other benchmarks (tunnel dispatch
+    # jitter is tens of percent run-to-run).
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_blocks):
+            d = native.encode_bytes(block, enc, ncols=ncols)
+            out = device_step(jnp.asarray(d.codes), jnp.asarray(d.labels))
+        jax.block_until_ready(out)
+        dt = min(dt, time.perf_counter() - t0)
     total = n_blocks * block_rows
 
     print(json.dumps({
