@@ -278,11 +278,25 @@ class HMMBuilder:
                     st_list.append(sc)
                     ob_list.append(ob_enc._map[seq[j]])
                     w_list.append(wf[k] if k < len(wf) else wf[-1])
-        emit = np.asarray(agg.weighted_transition_counts(
-            jnp.asarray(np.array(st_list, np.int32)),
-            jnp.asarray(np.array(ob_list, np.int32)),
-            jnp.asarray(np.array(w_list, np.float32)), s, o), np.float64) \
-            if st_list else np.zeros((s, o))
+        emit = np.zeros((s, o))
+        if st_list:
+            from avenir_tpu.parallel.mesh import maybe_shard_batch
+
+            st_all = np.array(st_list, np.int32)
+            ob_all = np.array(ob_list, np.int32)
+            w_all = np.array(w_list, np.float32)
+            # chunked accumulation in float64 on host: stays under the
+            # kernel's per-chunk cap on any corpus size and bounds f32
+            # rounding in the on-device partial sums. Mesh pad rows are
+            # neutral (−1 codes one-hot to zero, w pads to 0.0); float
+            # reduction order may differ in the last ulp under a mesh.
+            step = agg.MAX_EXACT_CHUNK_ROWS - 1
+            for s0 in range(0, len(st_all), step):
+                st_b, ob_b, w_b = maybe_shard_batch(
+                    self.mesh, st_all[s0:s0 + step], ob_all[s0:s0 + step],
+                    w_all[s0:s0 + step])
+                emit += np.asarray(agg.weighted_transition_counts(
+                    st_b, ob_b, w_b, s, o), np.float64)
         return self._normalize(st_enc, ob_enc, trans, emit, init)
 
     def _normalize(self, st_enc, ob_enc, trans, emit, init) -> HMMModel:

@@ -154,7 +154,10 @@ def transition_counts(a: jax.Array, b: jax.Array, num_a: int, num_b: int) -> jax
 def weighted_transition_counts(
     a: jax.Array, b: jax.Array, w: jax.Array, num_a: int, num_b: int
 ) -> jax.Array:
-    """Weighted co-occurrence sums (float) — partially-tagged HMM windows."""
+    """Weighted co-occurrence sums (float) — partially-tagged HMM windows.
+    −1 codes are count-neutral (zero one-hot rows), so mesh pad rows with
+    w=0 contribute nothing either way."""
+    _check_chunk(a)
     return jnp.einsum("ma,mb,m->ab", one_hot(a, num_a), one_hot(b, num_b), w, precision="highest")
 
 
