@@ -18,12 +18,12 @@ in-tree MXU matmul (models/knn.py), so:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
 from avenir_tpu.core.config import JobConfig
-from avenir_tpu.jobs.base import Job, read_input, read_lines, write_output
+from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import knn as mknn
 from avenir_tpu.models import naive_bayes as nb
 from avenir_tpu.utils.metrics import Counters

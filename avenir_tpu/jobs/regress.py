@@ -6,8 +6,6 @@ discriminant/FisherDiscriminant.java).
 from __future__ import annotations
 
 import os
-from typing import List
-
 import numpy as np
 
 from avenir_tpu.core.config import JobConfig

@@ -20,12 +20,12 @@ from __future__ import annotations
 
 import json
 import os
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 
 from avenir_tpu.core.config import JobConfig
-from avenir_tpu.jobs.base import Job, read_input, read_lines, write_output
+from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import tree as dtree
 from avenir_tpu.utils.metrics import ConfusionMatrix, Counters
 
