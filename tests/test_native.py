@@ -178,3 +178,57 @@ def test_native_mt_error_row_absolute(built):
     data = _csv_bytes(rows)
     with pytest.raises(ValueError, match=f"unknown class label at row {bad}"):
         native.encode_bytes(data, enc, ncols=len(rows[0]), nthreads=8)
+
+
+def test_native_fuzz_parity_with_python(built):
+    # randomized adversarial parity: numeric fields exercising the fast
+    # float parser (signs, fractions, exponents, long digit strings,
+    # whitespace fallback), categorical values colliding with delimiter-
+    # adjacent SWAR edge bytes, CRLF/blank-line mixes — native must match
+    # the Python encoder byte-for-byte on every draw
+    rng = np.random.default_rng(20260730)
+    cats = ["a", "-", "+x", "..", "zz-9", "e9", "n/a", "0"]
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "num", "ordinal": 1, "dataType": "int", "feature": True,
+         "bucketWidth": 3, "min": -50, "max": 50},
+        {"name": "cat", "ordinal": 2, "dataType": "categorical",
+         "feature": True, "cardinality": cats},
+        {"name": "x", "ordinal": 3, "dataType": "double", "feature": True},
+        {"name": "cls", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]})
+    enc = DatasetEncoder(schema)
+
+    def rand_num():
+        k = rng.integers(0, 6)
+        if k == 0:
+            return str(rng.integers(-50, 51))
+        if k == 1:
+            return f"{rng.uniform(-50, 50):.9f}"
+        if k == 2:
+            return f"{rng.uniform(-1, 1):.2e}"          # exponent: slow path
+        if k == 3:
+            return f"  {rng.integers(-9, 10)}"          # whitespace: slow path
+        if k == 4:
+            return f"-{rng.integers(0, 9)}.{rng.integers(0, 10**12)}"
+        return f"{rng.integers(-5, 5)}."                 # trailing dot
+
+    for trial in range(30):
+        n = int(rng.integers(1, 120))
+        rows = []
+        for i in range(n):
+            rows.append([f"id-{i}", rand_num(),
+                         cats[rng.integers(0, len(cats))]
+                         if rng.random() < 0.9 else "OOV!",
+                         rand_num(), "NY"[rng.integers(0, 2)]])
+        arr = np.array(rows, dtype=object)
+        eol = "\r\n" if trial % 3 == 0 else "\n"
+        blanks = "\n\r\n" if trial % 5 == 0 else ""
+        data = (blanks + eol.join(",".join(r) for r in rows) + eol).encode()
+        py = enc.transform(arr)
+        nat = native.encode_bytes(data, enc, ncols=5)
+        np.testing.assert_array_equal(nat.codes, py.codes, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(nat.cont, py.cont, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(nat.labels, py.labels, err_msg=f"trial {trial}")
+        assert list(nat.ids) == [r[0] for r in rows]
