@@ -215,23 +215,34 @@ class Job:
             return None
         parts = []
         lines: Optional[List[str]] = [] if want_lines else None
-        ncols = None
         for f in input_files(input_path):
             with open(f, "rb") as fh:
                 data = fh.read()
             if not data.strip():
                 continue
-            if ncols is None:
-                # first NON-BLANK line (leading blank/CRLF lines are data
-                # the encoder itself skips)
-                first = next((ln for ln in data.split(b"\n")
-                              if ln.strip()), b"").rstrip(b"\r")
-                ncols = first.count(delim.encode()) + 1
-                if ncols <= enc.max_ordinal(with_labels):
-                    # narrower file than the schema consumes: the Python
-                    # path degrades gracefully (e.g. labels=None when the
-                    # class column is absent); never index C++ out of range
-                    return None
+            # sniff ncols PER FILE from its first non-blank line (leading
+            # blank/CRLF lines are data the encoder itself skips): parts of
+            # a multi-file input directory may differ in width, and the
+            # narrow-file guard must run for each one. Scan with find()
+            # instead of split() — splitting allocates a list of every line
+            # just to read the first one.
+            first = b""
+            pos = 0
+            while pos < len(data):
+                nl = data.find(b"\n", pos)
+                ln = data[pos:] if nl < 0 else data[pos:nl]
+                if ln.strip():
+                    first = ln.rstrip(b"\r")
+                    break
+                if nl < 0:
+                    break
+                pos = nl + 1
+            ncols = first.count(delim.encode()) + 1
+            if ncols <= enc.max_ordinal(with_labels):
+                # narrower file than the schema consumes: the Python
+                # path degrades gracefully (e.g. labels=None when the
+                # class column is absent); never index C++ out of range
+                return None
             parts.append(native.encode_bytes(data, enc, ncols=ncols,
                                              delim=delim,
                                              with_labels=with_labels))

@@ -15,6 +15,7 @@ verb-for-verb.
 
 from __future__ import annotations
 
+import math
 import os
 from typing import Dict, List, Tuple
 
@@ -24,11 +25,19 @@ from avenir_tpu.jobs.base import Job, input_files, write_output
 from avenir_tpu.utils.metrics import Counters
 
 
-def _fmt(x: float) -> str:
-    """Compact numeric formatting: ints stay ints, floats keep 6 sig figs."""
-    if x == int(x):
+def _fmt(x: float, precision: int = 6) -> str:
+    """Compact numeric formatting: ints stay ints, floats keep ``precision``
+    sig figs; non-finite values print as-is (nan/inf/-inf)."""
+    if math.isfinite(x) and x == int(x):
         return str(int(x))
-    return f"{x:.6g}"
+    return f"{x:.{precision}g}"
+
+
+def _fmt_full(x: float) -> str:
+    """Full-precision formatting for accumulated moments: 6 sig figs would
+    throw away exactly the digits the f64 accumulation preserves (e.g. a
+    mean of 1e7 + 0.0118)."""
+    return _fmt(x, precision=15)
 
 
 class RunningAggregator(Job):
@@ -166,7 +175,7 @@ class NumericalAttrStats(Job):
         if not rows.size or not attr_ords:
             write_output(output_path, [])
             return
-        vals = rows[:, attr_ords].astype(np.float32)
+        vals64 = rows[:, attr_ords].astype(np.float64)
         if cond_ord is not None:
             cond_vals = [str(v) for v in rows[:, cond_ord]]
             uniq = sorted(set(cond_vals))
@@ -175,6 +184,19 @@ class NumericalAttrStats(Job):
         else:
             uniq = [""]
             labels = np.zeros(len(rows), np.int32)
+        # Shift each value by its f64 per-(group, column) mean before the f32
+        # device pass: the E[x²]−E[x]² form on raw f32 sums cancels
+        # catastrophically when |mean| >> std (the reference chombo job
+        # accumulates in double). The shift must be per GROUP, not global —
+        # with conditioned groups whose means are far apart, a global shift
+        # still leaves each group's values large in f32. Raw sum/sumSq lines
+        # are reconstructed in f64 below.
+        shift = np.zeros((len(uniq), len(attr_ords)))
+        for ci in range(len(uniq)):
+            sel = vals64[labels == ci]
+            if len(sel):
+                shift[ci] = sel.mean(axis=0)
+        vals = (vals64 - shift[labels]).astype(np.float32)
         from avenir_tpu.parallel.mesh import maybe_shard_batch
         vals_b, labels_b = maybe_shard_batch(self.auto_mesh(conf), vals, labels)
         cnt, s1, s2 = (np.asarray(a) for a in agg.class_moments(
@@ -182,20 +204,30 @@ class NumericalAttrStats(Job):
 
         d = conf.field_delim
         lines: List[str] = []
+        cnt = cnt.astype(np.float64)
+        s1 = s1.astype(np.float64)
+        s2 = s2.astype(np.float64)
         for ai, aord in enumerate(attr_ords):
-            col = vals[:, ai]
+            col = vals64[:, ai]
             for ci, cval in enumerate(uniq):
                 n = cnt[ci]
                 if not n:
                     continue
-                mean = s1[ci, ai] / n
-                var = max(s2[ci, ai] / n - mean * mean, 0.0)
+                m = float(shift[ci, ai])
+                # shifted-space mean/var (stable), raw sum/sumSq rebuilt in f64
+                mean_s = s1[ci, ai] / n
+                var = max(s2[ci, ai] / n - mean_s * mean_s, 0.0)
+                raw_sum = s1[ci, ai] + n * m
+                raw_sumsq = s2[ci, ai] + 2.0 * m * s1[ci, ai] + n * m * m
                 sub = col[labels == ci]
                 fields = [str(aord)] + ([cval] if cond_ord is not None else [])
-                fields += [_fmt(float(n)), _fmt(float(s1[ci, ai])),
-                           _fmt(float(s2[ci, ai])), _fmt(float(mean)),
-                           _fmt(float(var)), _fmt(float(np.sqrt(var))),
-                           _fmt(float(sub.min())), _fmt(float(sub.max()))]
+                fields += [_fmt(float(n)), _fmt_full(float(raw_sum)),
+                           _fmt_full(float(raw_sumsq)),
+                           _fmt_full(float(mean_s + m)),
+                           _fmt_full(float(var)),
+                           _fmt_full(float(np.sqrt(var))),
+                           _fmt_full(float(sub.min())),
+                           _fmt_full(float(sub.max()))]
                 lines.append(d.join(fields))
         write_output(output_path, lines)
         counters.set("Records", "Processed", len(rows))

@@ -290,7 +290,12 @@ class HMMBuilder:
             # rounding in the on-device partial sums. Mesh pad rows are
             # neutral (−1 codes one-hot to zero, w pads to 0.0); float
             # reduction order may differ in the last ulp under a mesh.
-            step = agg.MAX_EXACT_CHUNK_ROWS - 1
+            # The step is a multiple of the data-axis size so that mesh
+            # padding (up to the next multiple of d) can never push a full
+            # chunk to >= the cap.
+            d = (self.mesh.shape.get("data", 1)
+                 if self.mesh is not None else 1) or 1
+            step = ((agg.MAX_EXACT_CHUNK_ROWS - 1) // d) * d
             for s0 in range(0, len(st_all), step):
                 st_b, ob_b, w_b = maybe_shard_batch(
                     self.mesh, st_all[s0:s0 + step], ob_all[s0:s0 + step],

@@ -261,7 +261,14 @@ long encode_range(
     const char* line_end = nl ? nl : end;
     const char* trimmed = line_end;
     if (trimmed > p && trimmed[-1] == '\r') --trimmed;
-    if (trimmed == p) {  // blank line
+    // skip blank AND whitespace-only lines: the Python ingest path filters
+    // on line.strip(), so a line of spaces/tabs must not parse as a 1-field
+    // row here and fail the ragged-record check
+    const char* ws = p;
+    while (ws < trimmed &&
+           (*ws == ' ' || *ws == '\t' || *ws == '\v' || *ws == '\f' ||
+            *ws == '\r')) ++ws;
+    if (ws == trimmed) {
       p = nl ? nl + 1 : end;
       continue;
     }

@@ -178,3 +178,69 @@ def test_numerical_attr_stats_unconditioned(tmp_path):
     assert float(out["0"][4]) == pytest.approx(2.0)
     assert float(out["1"][2]) == pytest.approx(60.0)
     assert float(out["1"][8]) == pytest.approx(30.0)
+
+
+def test_numerical_attr_stats_large_magnitude(tmp_path):
+    # |mean| >> std: naive f32 E[x^2]-E[x]^2 cancels catastrophically; the
+    # job must shift by the column mean and rebuild raw moments in f64
+    # (the reference chombo job accumulates in double)
+    rng = np.random.default_rng(11)
+    base = 1.0e7
+    x = base + rng.normal(0.0, 1.0, size=4000)
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "d.txt").write_text(
+        "\n".join(f"{v:.6f}" for v in x) + "\n")
+    conf = JobConfig({"attr.list": "0"})
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        conf, str(tmp_path / "in"), str(tmp_path / "out"))
+    f = read_lines(str(tmp_path / "out"))[0].split(",")
+    # attr, count, sum, sumSq, mean, var, std, min, max
+    assert float(f[1]) == 4000
+    assert float(f[4]) == pytest.approx(x.mean(), rel=1e-9)
+    assert float(f[6]) == pytest.approx(x.std(), rel=0.05)
+    assert float(f[2]) == pytest.approx(x.sum(), rel=1e-9)
+    assert float(f[3]) == pytest.approx((x * x).sum(), rel=1e-7)
+
+
+def test_numerical_attr_stats_conditioned_large_magnitude(tmp_path):
+    # per-GROUP mean shift: group means far apart (0 vs 1e7) with std 1 —
+    # a global shift would still leave each group's values ~5e6 in f32 and
+    # cancel the spread; per-group shift must preserve it
+    rng = np.random.default_rng(13)
+    rows = []
+    vals = {"a": [], "b": []}
+    for _ in range(3000):
+        g = "a" if rng.random() < 0.5 else "b"
+        v = float(f"{rng.normal(0.0 if g == 'a' else 1.0e7, 1.0):.6f}")
+        vals[g].append(v)
+        rows.append(f"{v:.6f},{g}")
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "d.txt").write_text("\n".join(rows) + "\n")
+    conf = JobConfig({"attr.list": "0", "cond.attr.ord": "1"})
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        conf, str(tmp_path / "in"), str(tmp_path / "out"))
+    out = {}
+    for line in read_lines(str(tmp_path / "out")):
+        f = line.split(",")
+        out[f[1]] = [float(v) for v in f[2:]]
+    for g in ("a", "b"):
+        ref = np.asarray(vals[g])
+        assert out[g][0] == len(ref)
+        assert out[g][3] == pytest.approx(ref.mean(), abs=1e-3)
+        assert out[g][5] == pytest.approx(ref.std(), rel=0.05)   # std survives
+        # group-a sum is ~34 built from f32 partial sums of ±4 values: exact
+        # to ~1e-4 abs; group-b sum ~1.5e10 must hold 1e-9 relative
+        assert out[g][1] == pytest.approx(ref.sum(), rel=1e-9, abs=1e-3)
+
+
+def test_numerical_attr_stats_nonfinite_input(tmp_path):
+    # nan/inf values in a numeric column must print as nan/inf, not crash
+    # the int-vs-float formatter
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "d.txt").write_text("1.5\nnan\n2.5\n")
+    conf = JobConfig({"attr.list": "0"})
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        conf, str(tmp_path / "in"), str(tmp_path / "out"))
+    f = read_lines(str(tmp_path / "out"))[0].split(",")
+    assert f[1] == "3"
+    assert f[2] == "nan" or np.isnan(float(f[2]))

@@ -529,3 +529,23 @@ def test_streaming_mi_and_cramer_match_whole(churn_env):
             read_lines(str(root / f"{out}_w"))
         assert c.get("Records", "Processed") == 1600
         assert c.get("Task", "attempts") >= 6
+
+
+def test_native_ingest_multifile_differing_ncols(churn_env, tmp_path):
+    # ncols is sniffed PER part file: a later part narrower than the schema
+    # consumes must make the whole directory fall back to the Python path
+    # (graceful degradation), not encode against the first file's width and
+    # die on a ragged-record error
+    from avenir_tpu.jobs.base import Job
+
+    root, conf = churn_env
+    enc = Job.encoder_for(conf)
+    indir = tmp_path / "parts"
+    indir.mkdir()
+    with open(root / "train.csv") as fh:
+        full = [ln.rstrip("\n") for ln in fh if ln.strip()]
+    (indir / "part-0.csv").write_text("\n".join(full[:100]) + "\n")
+    # part-1 is missing the trailing class column
+    (indir / "part-1.csv").write_text(
+        "\n".join(ln.rsplit(",", 1)[0] for ln in full[100:200]) + "\n")
+    assert Job._encode_input_native(str(indir), enc, ",", True) is None

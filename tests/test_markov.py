@@ -216,3 +216,28 @@ def test_viterbi_decode_meshed_matches_single(rng):
     single = mk.ViterbiDecoder(model).decode_codes(obs)
     meshed = mk.ViterbiDecoder(model, mesh=make_mesh(("data",))).decode_codes(obs)
     np.testing.assert_array_equal(meshed, single)
+
+
+def test_hmm_partially_tagged_meshed_chunk_cap(monkeypatch):
+    # regression: the emission-chunk step must account for mesh padding —
+    # with cap=16 the old step (cap-1=15) padded to 16 on an 8-device mesh
+    # and tripped the per-chunk guard; the step must round down to a
+    # multiple of the data-axis size instead
+    from avenir_tpu.ops import agg
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setattr(agg, "MAX_EXACT_CHUNK_ROWS", 16)
+    rng = np.random.default_rng(9)
+    token_seqs = []
+    for _ in range(30):
+        seq = []
+        for _ in range(6):
+            seq.append("S1" if rng.random() < 0.5 else "S2")
+            seq.extend(rng.choice(["o1", "o2", "o3"], size=3).tolist())
+        token_seqs.append(seq)
+    kw = dict(states=["S1", "S2"], window_function=[1.0, 0.5, 0.25])
+    single = mk.HMMBuilder(laplace=0.1).fit_partially_tagged(token_seqs, **kw)
+    meshed = mk.HMMBuilder(laplace=0.1, mesh=make_mesh(("data",))) \
+        .fit_partially_tagged(token_seqs, **kw)
+    np.testing.assert_allclose(meshed.emission, single.emission, rtol=1e-6)
+    np.testing.assert_allclose(meshed.transition, single.transition, rtol=1e-9)

@@ -232,3 +232,20 @@ def test_native_fuzz_parity_with_python(built):
         np.testing.assert_array_equal(nat.cont, py.cont, err_msg=f"trial {trial}")
         np.testing.assert_array_equal(nat.labels, py.labels, err_msg=f"trial {trial}")
         assert list(nat.ids) == [r[0] for r in rows]
+
+
+def test_native_whitespace_only_lines(built):
+    # a line of spaces/tabs is filtered by the Python path's line.strip();
+    # the native encoder must skip it too instead of parsing a 1-field row
+    rows = generate_churn(20, seed=6)
+    enc, py_ds = _fitted(CHURN_SCHEMA_JSON, rows)
+    lines = [",".join(r) for r in rows]
+    lines.insert(10, " \t ")
+    lines.insert(5, "   ")
+    messy = ("   \n" + "\n".join(lines) + "\n \r \n\r\r\n\n").encode()
+    # sanity: the python filter sees exactly the 20 data rows
+    n_py = sum(1 for ln in messy.decode().split("\n") if ln.strip())
+    assert n_py == 20
+    nat = native.encode_bytes(messy, enc, ncols=rows.shape[1])
+    np.testing.assert_array_equal(nat.codes, py_ds.codes)
+    np.testing.assert_array_equal(nat.labels, py_ds.labels)
