@@ -198,6 +198,30 @@ class Job:
                           for row in rows]
 
     @staticmethod
+    def _sniff_ncols(path: str, delim: str, block: int = 1 << 16) -> int:
+        """Field count of the first non-blank line of ``path``, reading in
+        bounded blocks (never the whole file). 0 when the file has no
+        non-blank line."""
+        buf = b""
+        with open(path, "rb") as fh:
+            while True:
+                chunk = fh.read(block)
+                buf += chunk
+                pos = 0
+                while True:
+                    nl = buf.find(b"\n", pos)
+                    if nl < 0:
+                        break
+                    ln = buf[pos:nl]
+                    if ln.strip():
+                        return ln.rstrip(b"\r").count(delim.encode()) + 1
+                    pos = nl + 1
+                buf = buf[pos:]
+                if not chunk:                  # EOF: trailing partial line
+                    return (buf.rstrip(b"\r").count(delim.encode()) + 1
+                            if buf.strip() else 0)
+
+    @staticmethod
     def _encode_input_native(input_path: str, enc: DatasetEncoder,
                              delim: str, with_labels: bool,
                              want_lines: bool = False):
@@ -213,36 +237,28 @@ class Job:
         if not native.is_available() or \
                 not (enc._fitted or enc.schema_complete(with_labels)):
             return None
-        parts = []
-        lines: Optional[List[str]] = [] if want_lines else None
+        # Pre-pass: sniff ncols for EVERY part file (bounded reads — no part
+        # is loaded whole) before encoding any. Parts of a multi-file input
+        # directory may differ in width, and a narrow part anywhere must
+        # divert the whole directory to the Python path (graceful
+        # degradation) — discovering that after encoding earlier parts would
+        # throw their work away.
+        files = []
         for f in input_files(input_path):
-            with open(f, "rb") as fh:
-                data = fh.read()
-            if not data.strip():
-                continue
-            # sniff ncols PER FILE from its first non-blank line (leading
-            # blank/CRLF lines are data the encoder itself skips): parts of
-            # a multi-file input directory may differ in width, and the
-            # narrow-file guard must run for each one. Scan with find()
-            # instead of split() — splitting allocates a list of every line
-            # just to read the first one.
-            first = b""
-            pos = 0
-            while pos < len(data):
-                nl = data.find(b"\n", pos)
-                ln = data[pos:] if nl < 0 else data[pos:nl]
-                if ln.strip():
-                    first = ln.rstrip(b"\r")
-                    break
-                if nl < 0:
-                    break
-                pos = nl + 1
-            ncols = first.count(delim.encode()) + 1
+            ncols = Job._sniff_ncols(f, delim)
+            if ncols == 0:
+                continue                       # empty/blank file: skip
             if ncols <= enc.max_ordinal(with_labels):
                 # narrower file than the schema consumes: the Python
                 # path degrades gracefully (e.g. labels=None when the
                 # class column is absent); never index C++ out of range
                 return None
+            files.append((f, ncols))
+        parts = []
+        lines: Optional[List[str]] = [] if want_lines else None
+        for f, ncols in files:
+            with open(f, "rb") as fh:
+                data = fh.read()
             parts.append(native.encode_bytes(data, enc, ncols=ncols,
                                              delim=delim,
                                              with_labels=with_labels))
@@ -269,7 +285,8 @@ class Job:
         return (ds, lines) if want_lines else ds
 
     def encoded_data_source(self, conf: JobConfig, input_path: str,
-                            counters: Counters, with_labels: bool = True):
+                            counters: Counters, with_labels: bool = True,
+                            mesh=None):
         """(encoder, data, rows_fn) for count-aggregation jobs whose model
         ``fit`` accepts either one EncodedDataset or a chunk iterable.
 
@@ -277,7 +294,15 @@ class Job:
         stream (:meth:`iter_encoded_retrying`) so arbitrarily large inputs
         never materialize whole; otherwise it is the whole encoded input
         (native path when eligible). ``rows_fn()`` reports rows processed —
-        call it only after ``fit`` has consumed the stream."""
+        call it only after ``fit`` has consumed the stream.
+
+        The chunk stream is pulled through a :class:`DeviceFeeder`
+        (``stream.prefetch.depth`` buffers, default 2; 0 disables): a worker
+        thread runs the read+parse+encode of chunk N+1 and stages its arrays
+        on device (sharded over ``mesh`` when given — the same placement the
+        model's fit would apply) while the compiled step consumes chunk N —
+        the I/O/compute overlap Hadoop's mapper JVMs gave the reference for
+        free."""
         if conf.get("stream.chunk.rows"):
             enc = self.encoder_for(conf)
             box = {"n": 0}
@@ -289,7 +314,23 @@ class Job:
                     box["n"] += d.num_rows
                     yield d
 
-            return enc, chunks(), lambda: box["n"]
+            data = chunks()
+            depth = conf.get_int("stream.prefetch.depth", 2)
+            if depth > 0:
+                from avenir_tpu.runtime.feeder import DeviceFeeder
+
+                def stage(ds):
+                    from avenir_tpu.parallel.mesh import maybe_shard_batch
+                    codes, labels, cont = maybe_shard_batch(
+                        mesh, ds.codes, ds.labels, ds.cont)
+                    return EncodedDataset(
+                        codes=codes, cont=cont, labels=labels, ids=ds.ids,
+                        n_bins=ds.n_bins, class_values=ds.class_values,
+                        binned_ordinals=ds.binned_ordinals,
+                        cont_ordinals=ds.cont_ordinals)
+
+                data = DeviceFeeder(data, depth=depth, stage=stage)
+            return enc, data, lambda: box["n"]
         enc, ds, _rows = self.encode_input(conf, input_path,
                                            with_labels=with_labels,
                                            need_rows=False)

@@ -30,7 +30,8 @@ class BayesianDistribution(Job):
                                mesh=self.auto_mesh(conf))
         # stream.chunk.rows switches to the chunked read+encode stream under
         # the task-retry policy (needs a schema-complete encoder)
-        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters)
+        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters,
+                                                      mesh=nbayes.mesh)
         model = nbayes.fit(data)
         lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
         write_output(output_path, lines)

@@ -191,11 +191,18 @@ class NumericalAttrStats(Job):
         # with conditioned groups whose means are far apart, a global shift
         # still leaves each group's values large in f32. Raw sum/sumSq lines
         # are reconstructed in f64 below.
+        # The shift is the mean of the FINITE values only: an inf row must
+        # stay inf after shifting (inf - inf would turn it into nan and
+        # change what the output prints).
         shift = np.zeros((len(uniq), len(attr_ords)))
         for ci in range(len(uniq)):
             sel = vals64[labels == ci]
-            if len(sel):
-                shift[ci] = sel.mean(axis=0)
+            fin = np.isfinite(sel)
+            n_fin = fin.sum(axis=0)
+            shift[ci] = np.where(
+                n_fin > 0,
+                np.where(fin, sel, 0.0).sum(axis=0) / np.maximum(n_fin, 1),
+                0.0)
         vals = (vals64 - shift[labels]).astype(np.float32)
         from avenir_tpu.parallel.mesh import maybe_shard_batch
         vals_b, labels_b = maybe_shard_batch(self.auto_mesh(conf), vals, labels)

@@ -35,10 +35,12 @@ class MutualInformation(Job):
                 counters: Counters) -> None:
         delim = conf.field_delim
         schema = self.load_schema(conf)
-        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters)
+        mesh = self.auto_mesh(conf)
+        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters,
+                                                      mesh=mesh)
         names = [schema.field_by_ordinal(f.ordinal).name
                  for f in enc.binned_fields]
-        result = mi.MutualInformation(mesh=self.auto_mesh(conf)).fit(
+        result = mi.MutualInformation(mesh=mesh).fit(
             data, feature_names=names)
         lines: List[str] = []
         if conf.get_bool("output.mutual.info", True):
@@ -66,7 +68,9 @@ class _CorrelationJob(Job):
                 counters: Counters) -> None:
         delim = conf.field_delim
         schema = self.load_schema(conf)
-        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters)
+        mesh = self.auto_mesh(conf)
+        enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters,
+                                                      mesh=mesh)
         binned_ords = [f.ordinal for f in enc.binned_fields]
         names = [schema.field_by_ordinal(o).name for o in binned_ords]
         # source/dest attribute lists arrive as schema ordinals
@@ -77,7 +81,7 @@ class _CorrelationJob(Job):
         class_ord = schema.class_field.ordinal if schema.class_field else None
         against_class = dst is not None and class_ord is not None and dst == [class_ord]
         job = corr.CategoricalCorrelation(algorithm=self._algorithm(conf),
-                                          mesh=self.auto_mesh(conf))
+                                          mesh=mesh)
         result = job.fit(
             data,
             src=[ord_to_idx[o] for o in src] if src else None,

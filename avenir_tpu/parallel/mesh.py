@@ -206,7 +206,27 @@ def maybe_shard_batch(mesh, *arrays, data_axis: str = "data"):
     Single-process only, like :func:`device_put_sharded_batch`; multi-host
     callers build arrays with ``make_array_from_process_local_data``.
     Always returns a list matching ``arrays``."""
+    def placed(a) -> bool:
+        # staged already (e.g. by the DeviceFeeder prefetch path, which runs
+        # this same sharding on its worker thread): transferring again would
+        # serialize exactly the copy the feeder overlapped. A bare jax.Array
+        # only counts as placed when no >1-device mesh is requested OR it
+        # already carries this mesh's batch sharding — a single-device array
+        # must still be resharded, not silently run unsharded.
+        if a is None:
+            return True
+        if not isinstance(a, jax.Array):
+            return False
+        if mesh is None or mesh.shape.get(data_axis, 1) <= 1:
+            return True
+        sh = a.sharding
+        return (isinstance(sh, NamedSharding) and sh.mesh == mesh and
+                len(sh.spec) > 0 and sh.spec[0] == data_axis)
+
+    if all(placed(a) for a in arrays):
+        return list(arrays)
     if mesh is not None and mesh.shape.get(data_axis, 1) > 1:
+        arrays = tuple(None if a is None else np.asarray(a) for a in arrays)
         out = device_put_sharded_batch(mesh, *arrays, data_axis=data_axis)
         return out if len(arrays) > 1 else [out]
     return [None if a is None else jnp.asarray(a) for a in arrays]

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
 import jax
@@ -20,19 +21,62 @@ T = TypeVar("T")
 _SENTINEL = object()
 
 
+def _put_guarded(q: "queue.Queue", stop: threading.Event, item) -> bool:
+    """Bounded put that gives up when the consumer is gone."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _produce(it: Iterator, stage: Callable, q: "queue.Queue",
+             stop: threading.Event, err_box: dict) -> None:
+    try:
+        for item in it:
+            if stop.is_set():
+                return
+            if not _put_guarded(q, stop, stage(item)):
+                return
+    except BaseException as e:         # propagate to the consumer
+        err_box["err"] = e
+    finally:
+        _put_guarded(q, stop, _SENTINEL)
+
+
 class DeviceFeeder:
     """Prefetching iterator: pulls from ``source`` on a worker thread,
     applies ``stage`` (default: ``jax.device_put`` of array leaves), and
-    hands off through a bounded queue (``depth`` buffers in flight)."""
+    hands off through a bounded queue (``depth`` buffers in flight).
+
+    Abandoning the iterator mid-stream (consumer raised, GC'd the feeder, or
+    called :meth:`close`) unblocks and stops the worker — staged device
+    buffers are dropped rather than pinned for the life of the process."""
 
     def __init__(self, source: Iterable[T], depth: int = 2,
                  stage: Optional[Callable[[T], T]] = None,
                  device: Optional[jax.Device] = None):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
-        self._stage = stage or (lambda item: self._default_stage(item, device))
-        self._err: Optional[BaseException] = None
+        self._err_box: dict = {}
+        self._stop = threading.Event()
+        self._done = False
+        # the worker must NOT hold a reference to self (a bound-method
+        # target would keep the feeder alive for as long as the thread
+        # runs, so the GC finalizer below could never fire); it closes over
+        # only the queue, the stop event, and the error box
         self._thread = threading.Thread(
-            target=self._produce, args=(iter(source),), daemon=True)
+            target=_produce,
+            args=(iter(source),
+                  stage or (lambda item, _d=device:
+                            DeviceFeeder._default_stage(item, _d)),
+                  self._q, self._stop, self._err_box),
+            daemon=True)
+        # unblock the worker when the consumer drops the feeder without
+        # exhausting it (fit raised mid-stream); the finalizer must not
+        # reference self or it would keep the feeder alive forever
+        self._finalizer = weakref.finalize(self, self._stop.set)
         self._thread.start()
 
     @staticmethod
@@ -43,24 +87,37 @@ class DeviceFeeder:
             return x
         return jax.tree_util.tree_map(put, item)
 
-    def _produce(self, it: Iterator[T]) -> None:
+    def close(self) -> None:
+        """Stop the worker and drop any staged-but-unconsumed buffers."""
+        self._stop.set()
+        self._done = True
+        self._drain()
+        # a put blocked past its stop check can still land one item after
+        # the first drain; once the worker has exited nothing else can be
+        # enqueued, so join-then-drain makes the drop reliable
+        self._thread.join(timeout=10.0)
+        self._drain()
+
+    def _drain(self) -> None:
         try:
-            for item in it:
-                self._q.put(self._stage(item))
-        except BaseException as e:     # propagate to the consumer
-            self._err = e
-        finally:
-            self._q.put(_SENTINEL)
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._done:
+            raise StopIteration
         item = self._q.get()
         if item is _SENTINEL:
+            self._done = True
             self._thread.join()
-            if self._err is not None:
-                raise self._err
+            err = self._err_box.pop("err", None)
+            if err is not None:
+                raise err
             raise StopIteration
         return item
 

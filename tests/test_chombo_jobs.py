@@ -244,3 +244,18 @@ def test_numerical_attr_stats_nonfinite_input(tmp_path):
     f = read_lines(str(tmp_path / "out"))[0].split(",")
     assert f[1] == "3"
     assert f[2] == "nan" or np.isnan(float(f[2]))
+
+
+def test_numerical_attr_stats_inf_input(tmp_path):
+    # an inf value must keep sum/mean at inf (shift computed over finite
+    # values only), not collapse to nan via inf-minus-inf
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "d.txt").write_text("1.5\ninf\n2.5\n")
+    conf = JobConfig({"attr.list": "0"})
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        conf, str(tmp_path / "in"), str(tmp_path / "out"))
+    f = read_lines(str(tmp_path / "out"))[0].split(",")
+    # attr, count, sum, sumSq, mean, var, std, min, max
+    assert float(f[2]) == float("inf")
+    assert float(f[4]) == float("inf")
+    assert float(f[8]) == float("inf")         # max

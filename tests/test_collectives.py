@@ -197,3 +197,24 @@ def test_sharded_mi_step_matches_local():
     np.testing.assert_array_equal(pabc, ref_pabc)
     np.testing.assert_array_equal(np.asarray(fbc), ref_fbc)
     np.testing.assert_array_equal(np.asarray(cc), np.bincount(labels, minlength=c))
+
+
+def test_maybe_shard_batch_reshards_unsharded_jax_arrays():
+    # a jax.Array staged WITHOUT the mesh (plain device_put) must still be
+    # resharded+padded by maybe_shard_batch under a >1-device mesh — only
+    # arrays already carrying the mesh's batch sharding pass through
+    import jax
+    import numpy as np
+
+    from avenir_tpu.parallel.mesh import (data_sharding, make_mesh,
+                                          maybe_shard_batch)
+
+    mesh = make_mesh(("data",))
+    assert mesh.shape["data"] > 1
+    x = jax.device_put(np.arange(12, dtype=np.int32))     # single-device
+    [out] = maybe_shard_batch(mesh, x)
+    assert out.sharding == data_sharding(mesh, 1)
+    assert out.shape[0] % mesh.shape["data"] == 0          # padded
+
+    [out2] = maybe_shard_batch(mesh, out)                  # already placed
+    assert out2 is out                                     # pass-through

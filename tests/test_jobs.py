@@ -549,3 +549,42 @@ def test_native_ingest_multifile_differing_ncols(churn_env, tmp_path):
     (indir / "part-1.csv").write_text(
         "\n".join(ln.rsplit(",", 1)[0] for ln in full[100:200]) + "\n")
     assert Job._encode_input_native(str(indir), enc, ",", True) is None
+
+
+def test_streaming_prefetch_feeder_engages_and_matches(churn_env, monkeypatch):
+    # streamed jobs pull chunks through the DeviceFeeder (worker-thread
+    # encode + device staging); output must be identical with prefetch on
+    # (default), off (stream.prefetch.depth=0), and the whole-input path —
+    # and the feeder must actually engage and stage chunks as device arrays
+    import jax
+
+    from avenir_tpu.jobs import base as jobs_base
+    from avenir_tpu.runtime.feeder import DeviceFeeder
+
+    root, conf = churn_env
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(root / "nb_whole"))
+    staged_types = []
+    orig_next = DeviceFeeder.__next__
+
+    def spying_next(self):
+        item = orig_next(self)
+        staged_types.append(type(item.codes))
+        return item
+
+    monkeypatch.setattr(DeviceFeeder, "__next__", spying_next)
+    sconf = JobConfig(dict(conf.props))
+    sconf.set("stream.chunk.rows", "300")
+    get_job("BayesianDistribution").run(sconf, str(root / "train.csv"),
+                                        str(root / "nb_stream"))
+    assert staged_types, "DeviceFeeder never engaged on the streamed path"
+    assert all(issubclass(t, jax.Array) for t in staged_types)
+    monkeypatch.setattr(DeviceFeeder, "__next__", orig_next)
+    nconf = JobConfig(dict(conf.props))
+    nconf.set("stream.chunk.rows", "300")
+    nconf.set("stream.prefetch.depth", "0")
+    get_job("BayesianDistribution").run(nconf, str(root / "train.csv"),
+                                        str(root / "nb_noprefetch"))
+    whole = read_lines(str(root / "nb_whole"))
+    assert read_lines(str(root / "nb_stream")) == whole
+    assert read_lines(str(root / "nb_noprefetch")) == whole

@@ -249,3 +249,51 @@ def test_native_whitespace_only_lines(built):
     nat = native.encode_bytes(messy, enc, ncols=rows.shape[1])
     np.testing.assert_array_equal(nat.codes, py_ds.codes)
     np.testing.assert_array_equal(nat.labels, py_ds.labels)
+
+
+def test_device_feeder_abandonment_stops_worker():
+    # a consumer that stops pulling (fit raised mid-stream) must not leave
+    # the worker thread blocked on the full queue forever
+    import threading
+    import time
+
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield np.full((4,), i)
+
+    feeder = DeviceFeeder(gen(), depth=2)
+    next(feeder)
+    th = feeder._thread
+    feeder.close()
+    th.join(timeout=5.0)
+    assert not th.is_alive()
+    assert len(produced) < 100                 # producer stopped early
+
+    # GC-dropped feeder (no explicit close) must also unblock the worker
+    feeder2 = DeviceFeeder(gen(), depth=2)
+    next(feeder2)
+    th2 = feeder2._thread
+    del feeder2
+    th2.join(timeout=5.0)
+    assert not th2.is_alive()
+
+
+def test_device_feeder_exhausted_raises_stopiteration_again():
+    feeder = DeviceFeeder([np.zeros(2)], depth=2)
+    assert len(list(feeder)) == 1
+    with pytest.raises(StopIteration):         # no hang after exhaustion
+        next(feeder)
+
+    def bad_gen():
+        yield np.zeros(2)
+        raise RuntimeError("boom")
+
+    f2 = DeviceFeeder(bad_gen())
+    next(f2)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(f2)
+    with pytest.raises(StopIteration):         # error already delivered
+        next(f2)
