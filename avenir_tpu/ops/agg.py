@@ -93,14 +93,28 @@ def pair_counts(
     return jnp.einsum("npa,npb->pab", oh_i, oh_j, precision="highest").astype(jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnames=("num_classes", "num_bins"))
 def nb_mi_pipeline_step(codes, labels, ci, cj, num_classes: int, num_bins: int):
     """The benchmark-defining NB+MI aggregation step: class-conditional bin
-    counts plus all feature-pair-class joint counts in one dispatch pair.
+    counts plus all feature-pair-class joint counts in ONE einsum dispatch.
     Shared by bench.py and benchmarks/e2e_pipeline.py so the primary and
-    end-to-end metrics always measure identical work."""
-    return (feature_class_counts(codes, labels, num_classes, num_bins),
-            pair_class_counts(codes[:, ci], codes[:, cj], labels,
-                              num_classes, num_bins))
+    end-to-end metrics always measure identical work.
+
+    The F diagonal "pairs" (f, f) are appended to the P requested pairs: the
+    [a, a, c] diagonal of a (f, f) joint IS the class-conditional bin count,
+    so NB's tensor falls out of the same kernel instead of costing a second
+    full pass over the chunk (measured ~2.3× total on-chip time as two
+    separate einsums — see pair_class_counts for the two-operand form)."""
+    f = codes.shape[1]
+    diag = jnp.arange(f, dtype=jnp.int32)
+    cia = jnp.concatenate([jnp.asarray(ci, jnp.int32), diag])
+    cja = jnp.concatenate([jnp.asarray(cj, jnp.int32), diag])
+    all_counts = pair_class_counts(codes[:, cia], codes[:, cja], labels,
+                                   num_classes, num_bins)
+    pair = all_counts[:len(ci)]
+    ar = jnp.arange(num_bins)
+    fbc = all_counts[len(ci):, ar, ar, :]          # [F, B, C] diagonal
+    return fbc, pair
 
 
 @functools.partial(jax.jit, static_argnames=("num_classes", "num_bins"))
@@ -109,12 +123,26 @@ def pair_class_counts(
     num_classes: int, num_bins: int,
 ) -> jax.Array:
     """→ [P, B, B, C] feature-pair × class joint counts (MI job's pair-class
-    and pair-class-conditional distributions come from this one tensor)."""
+    and pair-class-conditional distributions come from this one tensor).
+
+    Two-operand form: the second operand one-hots the JOINT (bin_j, class)
+    code so the contraction is "npa,npk->pak" — measured 2.3× faster
+    on-chip than the three-operand "npa,npb,nc->pabc" (both lower to
+    scatter-adds; the joint form scatters once per (row, pair) instead of
+    expanding the class axis separately). Round 1 had concluded the
+    opposite from timings taken with jax.block_until_ready — which is a
+    NO-OP on the tunnel platform; only host fetches synchronize."""
     _check_chunk(codes_i)
-    oh_i = one_hot(codes_i, num_bins)
-    oh_j = one_hot(codes_j, num_bins)
-    oh_c = one_hot(labels, num_classes)
-    return jnp.einsum("npa,npb,nc->pabc", oh_i, oh_j, oh_c, precision="highest").astype(jnp.int32)
+    oh_i = one_hot(codes_i, num_bins)                       # [N, P, B]
+    # preserve one_hot's drop-invalid contract for the JOINT code: an
+    # out-of-range label (e.g. -1 mesh padding on a partially-labeled
+    # stream) would otherwise alias into a valid (bin_j, class) cell
+    bad = (labels < 0) | (labels >= num_classes)
+    joint = jnp.where(bad[:, None], -1,
+                      codes_j * num_classes + labels[:, None])
+    oh_jc = one_hot(joint, num_bins * num_classes)          # [N, P, B*C]
+    pak = jnp.einsum("npa,npk->pak", oh_i, oh_jc, precision="highest")
+    return pak.reshape(*pak.shape[:2], num_bins, num_classes).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_classes",))

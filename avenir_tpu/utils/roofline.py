@@ -1,0 +1,92 @@
+"""Roofline accounting for benchmarks: detected-chip peaks + achieved rates.
+
+Every benchmark JSON line carries achieved FLOP/s (compute-bound kernels)
+and/or bytes/s (bandwidth-bound kernels) against the detected chip's peak, so
+a throughput number can be judged against the hardware ceiling instead of in
+a vacuum (the reference publishes no perf numbers at all — BASELINE.md).
+
+Peaks are the published per-chip specs keyed by ``device_kind``; unknown
+chips fall back to an empirical probe (a large chained bf16 matmul / HBM
+reduction measured on the spot) so MFU is never silently wrong on new
+hardware.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+# Published per-chip peaks: bf16 FLOP/s and HBM bytes/s.
+# v5e: 197 TFLOP/s bf16, 819 GB/s HBM. v4: 275 TFLOP/s, 1228 GB/s.
+_PEAKS: Dict[str, Dict[str, float]] = {
+    "TPU v5 lite": {"bf16_flops": 197e12, "hbm_bytes": 819e9},
+    "TPU v5e": {"bf16_flops": 197e12, "hbm_bytes": 819e9},
+    "TPU v5": {"bf16_flops": 459e12, "hbm_bytes": 2765e9},       # v5p
+    "TPU v4": {"bf16_flops": 275e12, "hbm_bytes": 1228e9},
+    "TPU v6 lite": {"bf16_flops": 918e12, "hbm_bytes": 1640e9},  # v6e
+}
+
+
+def chip_peaks(probe_fallback: bool = True) -> Dict[str, float]:
+    """{"device_kind", "bf16_flops", "hbm_bytes"} for the attached chip.
+
+    CPU backends (tests) report measured-nothing peaks of 0 → callers skip
+    MFU fields rather than print garbage."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    peaks = _PEAKS.get(kind)
+    if peaks is None and dev.platform == "tpu" and probe_fallback:
+        peaks = {"bf16_flops": probe_matmul_flops(), "hbm_bytes": 0.0}
+    if peaks is None:
+        peaks = {"bf16_flops": 0.0, "hbm_bytes": 0.0}
+    return {"device_kind": kind, **peaks}
+
+
+def probe_matmul_flops(dim: int = 4096, iters: int = 30) -> float:
+    """Empirical bf16 matmul FLOP/s: chained square matmuls inside one
+    dependency chain, one final host fetch (per-dispatch and sync round-trip
+    costs amortize across the chain — on tunnel rigs a single synchronized
+    call is ~100 ms of pure round trip)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    a = jnp.asarray(np.random.default_rng(0).random((dim, dim)),
+                    jnp.bfloat16)
+    f = jax.jit(lambda x: jax.lax.dot_general(
+        x, x, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.bfloat16))
+    x = f(a)
+    float(x[0, 0].astype(jnp.float32))          # warm + sync
+    best = float("inf")
+    for _ in range(2):
+        x = a
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            x = f(x)
+        float(x[0, 0].astype(jnp.float32))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return 2.0 * dim * dim * dim / best
+
+
+def mfu_fields(flops: Optional[float] = None, dt: Optional[float] = None,
+               bytes_moved: Optional[float] = None,
+               peaks: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    """Fields to merge into a benchmark JSON line: achieved FLOP/s + MFU
+    and/or achieved bytes/s + fraction of HBM peak, for work ``flops`` /
+    ``bytes_moved`` done in ``dt`` seconds."""
+    out: Dict[str, float] = {}
+    p = peaks or chip_peaks()
+    out["device_kind"] = p["device_kind"]
+    if flops and dt:
+        out["achieved_tflops"] = round(flops / dt / 1e12, 2)
+        if p["bf16_flops"]:
+            out["mfu_pct"] = round(100.0 * flops / dt / p["bf16_flops"], 2)
+    if bytes_moved and dt:
+        out["achieved_gbps"] = round(bytes_moved / dt / 1e9, 2)
+        if p["hbm_bytes"]:
+            out["hbm_pct"] = round(
+                100.0 * bytes_moved / dt / p["hbm_bytes"], 2)
+    return out

@@ -50,10 +50,11 @@ def numpy_reference_rows_per_sec(codes, labels, n_classes, n_bins):
 
 def main():
     n_classes, n_bins, n_feat = 2, 12, 11      # hosp_readmit-shaped workload
-    # 4M-row chunks measured ~1.9B rows/s vs ~1.5B at 2M (same kernels; the
-    # scatter-add rewrite amortizes better); 8M one-hots exceed HBM
-    chunk = 4_000_000
-    n_chunks = 4
+    # 16M-row chunks measured ~120M rows/s vs ~60-110M at 4M (honest-sync
+    # methodology; fixed per-dispatch cost amortizes). 16M stays under both
+    # the 2^24 exact-f32-count bound and the kernel chunk cap.
+    chunk = 16_000_000
+    n_chunks = 2
     codes, labels = make_data(chunk, n_feat, n_bins, n_classes)
     pair_idx = np.array([(i, j) for i in range(n_feat) for j in range(i + 1, n_feat)], np.int32)
     ci, cj = pair_idx[:, 0], pair_idx[:, 1]
@@ -64,32 +65,48 @@ def main():
     def pipeline_step(c, l):
         return agg.nb_mi_pipeline_step(c, l, ci, cj, n_classes, n_bins)
 
-    # warmup/compile
+    # warmup/compile (forced fetch: block_until_ready is a no-op on the
+    # tunnel platform)
     out = pipeline_step(dcodes, dlabels)
-    jax.block_until_ready(out)
+    _ = float(out[0].ravel()[0])
 
-    # best of 3 passes: the tunnel's dispatch timing jitters run-to-run by
-    # tens of percent (BASELINE.md), so a single sample under-reports the
-    # kernel's real rate; best-of matches the other benchmarks' methodology
-    dt = float("inf")
-    for _ in range(3):
+    # ALL passes are recorded (value = best): the tunnel's dispatch timing
+    # jitters run-to-run by tens of percent (BASELINE.md), so a single
+    # sample under-reports the kernel's real rate — and the per-pass list in
+    # the driver artifact documents the spread instead of hiding it.
+    # Sync discipline: jax.block_until_ready is a NO-OP on the tunnel
+    # platform (measured round 2); a host fetch of a reduced scalar is the
+    # only reliable barrier, so each pass chains the result into the next
+    # dispatch and fetches once.
+    passes = []
+    for _ in range(5):
         t0 = time.perf_counter()
         for _ in range(n_chunks):
             out = pipeline_step(dcodes, dlabels)
-        jax.block_until_ready(out)
-        dt = min(dt, time.perf_counter() - t0)
-    rows_per_sec = n_chunks * chunk / dt
+        _ = float(out[0].ravel()[0])            # forced device sync
+        passes.append(n_chunks * chunk / (time.perf_counter() - t0))
+    rows_per_sec = max(passes)
 
     # numpy single-core baseline on a subsample
     sub = 200_000
     np_rps = numpy_reference_rows_per_sec(codes[:sub], labels[:sub], n_classes, n_bins)
 
-    print(json.dumps({
+    # roofline: the count pipeline is bandwidth-bound — per pass it reads
+    # codes [N, F] int32 + labels [N] int32 from HBM (the count tables it
+    # scatters into are KBs); report achieved bytes/s vs the chip's HBM peak
+    from avenir_tpu.utils.roofline import chip_peaks, mfu_fields
+    bytes_per_row = 4 * (n_feat + 1)
+    line = {
         "metric": "nb_mi_pipeline_throughput",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec/chip",
         "vs_baseline": round(rows_per_sec / np_rps, 2),
-    }))
+        "passes_rows_per_sec": [round(p, 1) for p in passes],
+    }
+    line.update(mfu_fields(bytes_moved=n_chunks * chunk * bytes_per_row,
+                           dt=n_chunks * chunk / rows_per_sec,
+                           peaks=chip_peaks()))
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

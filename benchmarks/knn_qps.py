@@ -5,19 +5,33 @@ bench.py (NB+MI pipeline rows/sec/chip).
 
 Workload shape: 6 binned/categorical + 8 continuous attributes (elearn-like
 mixed records), k=10, exact top-k (verified against a numpy oracle in
-tests/test_knn.py). The engine is models/knn.nearest_neighbors: one compiled
-lax.scan over resident device tiles fusing distance matmuls with a running
-top-k merge, so the M×N distance matrix never materializes and the reference
-set uploads once.
+tests/test_knn.py; ``--verify`` runs the oracle check on-chip right here).
+
+Two rates are reported:
+- ``value`` (headline): PIPELINED throughput — batches of 4096 queries
+  stream through the fused single-dispatch search
+  (ops/pallas_knn.search_fused) with one final sync. This is the serving
+  shape: the tunnel/dispatch round-trip (~100 ms on the dev rig, measured)
+  amortizes across in-flight batches.
+- ``single_shot_qps``: one synchronized call including every round trip —
+  the latency floor a cold caller sees.
+
+Roofline fields (utils/roofline.py): the candidate kernel's matmul work is
+2·M·N·K FLOPs; ``mfu_pct`` is reported against the detected chip's bf16
+peak. The kernel is ~4.4× the best XLA alternative (measured chained, same
+sync discipline) but sits at single-digit MFU — Mosaic's per-block grid
+overhead, not MXU starvation; see BASELINE.md kNN notes.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
 
 from avenir_tpu.core.encoding import EncodedDataset
 from avenir_tpu.models import knn as mknn
+from avenir_tpu.utils.roofline import chip_peaks, mfu_fields
 
 
 def make_ds(rng, n, f=6, fc=8, nb=10):
@@ -29,13 +43,41 @@ def make_ds(rng, n, f=6, fc=8, nb=10):
         binned_ordinals=list(range(f)), cont_ordinals=list(range(f, f + fc)))
 
 
+def verify_on_chip(model, test, k, n_check=256, row_chunk=16):
+    """Exact-vs-oracle certificate on the compiled kernel (hardware path):
+    the first ``n_check`` rows' results must match a float64 numpy oracle.
+    The oracle runs in ``row_chunk``-row slices — a whole-batch broadcast
+    against 1M references would allocate a ~16 GB float64 temp."""
+    d, idx = mknn.nearest_neighbors(model, test, k=k)
+    cq_all = mknn._normalize01(test.cont[:n_check], model.cont_lo,
+                               model.cont_hi)
+    cr = model.cont01().astype(np.float64)
+    total = test.codes.shape[1] + test.cont.shape[1]
+    for r0 in range(0, n_check, row_chunk):
+        cq = cq_all[r0:r0 + row_chunk].astype(np.float64)
+        codes_q = test.codes[r0:r0 + row_chunk]
+        mism = (codes_q[:, None, :] != model.codes[None, :, :]).sum(-1)
+        d2 = mism + ((cq[:, None, :] - cr[None, :, :]) ** 2).sum(-1)
+        od = np.sqrt(np.sort(d2, axis=1)[:, :k] / total)
+        got = d[r0:r0 + row_chunk]
+        if not np.allclose(got, od, atol=1e-5):
+            bad = np.max(np.abs(got - od))
+            raise AssertionError(
+                f"on-chip kNN mismatch vs oracle: max |Δd|={bad}")
+    return True
+
+
 def main():
+    verify = "--verify" in sys.argv
     rng = np.random.default_rng(0)
     n_refs, n_queries, k = 1_000_000, 4096, 10
     model = mknn.fit_knn(make_ds(rng, n_refs))
     test = make_ds(rng, n_queries)
 
-    d_ex, i_ex = mknn.nearest_neighbors(model, test, k=k)   # compile + upload
+    mknn.nearest_neighbors(model, test, k=k)        # compile + upload
+    verified = verify_on_chip(model, test, k) if verify else None
+
+    # single-shot latency (cold-caller view: every round trip included)
     best = None
     for _ in range(3):
         t0 = time.perf_counter()
@@ -43,8 +85,33 @@ def main():
         dt = time.perf_counter() - t0
         best = min(best or dt, dt)
 
-    # flag-gated approximate mode (knn.search.mode=approx): report its QPS
-    # and measured recall alongside the exact headline number
+    # pipelined throughput: stream batches through the fused search, sync
+    # only at the end — per-pass values are all recorded so the driver
+    # artifact documents the spread
+    from avenir_tpu.ops import pallas_knn
+    nb = int(model.n_bins.max())
+    r_mat, n = model.device_packed(nb)
+    cr_dev, cx_dev = model.device_rerank_arrays()
+    batches = []
+    for i in range(6):
+        t = make_ds(rng, n_queries)
+        batches.append((t.codes,
+                        mknn._normalize01(t.cont, model.cont_lo, model.cont_hi)))
+    total_attrs = 6 + 8
+    outs = [pallas_knn.search_fused(c, x, r_mat, cr_dev, cx_dev, n, nb, k,
+                                    total_attrs) for c, x in batches[:1]]
+    np.asarray(outs[-1][0])                          # warm + sync
+    passes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [pallas_knn.search_fused(c, x, r_mat, cr_dev, cx_dev, n, nb,
+                                        k, total_attrs) for c, x in batches]
+        np.asarray(outs[-1][0])                      # device executes in order
+        passes.append(len(batches) * n_queries / (time.perf_counter() - t0))
+    pipelined = max(passes)
+
+    # approx mode comparison (flag-gated knn.search.mode=approx)
+    d_ex, i_ex = mknn.nearest_neighbors(model, test, k=k)
     _, i_ap = mknn.nearest_neighbors(model, test, k=k, mode="approx")
     best_ap = None
     for _ in range(3):
@@ -55,15 +122,26 @@ def main():
     recall = float(np.mean([len(set(i_ex[q]) & set(i_ap[q])) / k
                             for q in range(n_queries)]))
 
-    print(json.dumps({
+    # roofline: candidate-kernel matmul work per batch
+    width = r_mat.shape[1]
+    flops_per_batch = 2.0 * r_mat.shape[0] * ((n_queries + 511) // 512 * 512) * width
+    batch_dt = n_queries / pipelined
+    line = {
         "metric": "knn_qps_1m_refs",
-        "value": round(n_queries / best, 1),
+        "value": round(pipelined, 1),
         "unit": "queries/sec/chip",
         "k": k,
         "n_refs": n_refs,
+        "pipelined_passes_qps": [round(p, 1) for p in passes],
+        "single_shot_qps": round(n_queries / best, 1),
         "approx_qps": round(n_queries / best_ap, 1),
         "approx_recall": round(recall, 4),
-    }))
+    }
+    if verified is not None:
+        line["verified_vs_oracle"] = verified
+    line.update(mfu_fields(flops=flops_per_batch, dt=batch_dt,
+                           peaks=chip_peaks()))
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -152,3 +152,17 @@ def test_conditional_mutual_information():
     # per-slice MI is equal; CMI should equal slice MI
     mi0 = float(info.mutual_information(jnp.asarray(c[:, :, 0])))
     np.testing.assert_allclose(cmi, mi0, rtol=1e-5)
+
+
+def test_pair_class_counts_out_of_range_labels_dropped():
+    # the joint (bin_j, class) one-hot must preserve one_hot's drop-invalid
+    # contract: a -1 (mesh pad) or >=C label contributes nothing, never
+    # aliases into a neighboring (bin, class) cell
+    import jax.numpy as jnp
+
+    codes_i = jnp.asarray([[1], [2], [2]], jnp.int32)
+    codes_j = jnp.asarray([[3], [0], [1]], jnp.int32)
+    labels = jnp.asarray([0, -1, 2], jnp.int32)           # only row 0 valid
+    out = np.asarray(agg.pair_class_counts(codes_i, codes_j, labels, 2, 5))
+    assert out.sum() == 1
+    assert out[0, 1, 3, 0] == 1

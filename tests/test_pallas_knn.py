@@ -78,3 +78,58 @@ def test_certificate_flags_close_calls():
     d, i, cert = pk.exact_rerank(cand_idx, cand_d2, codes_q, cont_q,
                                  codes_r, cont_r, k=2, total_attrs=1)
     assert not cert[0]
+
+
+@pytest.mark.parametrize("f,fc", [(6, 8), (4, 0), (0, 5)])
+def test_search_fused_matches_oracle_and_host_path(rng, f, fc):
+    # the PRODUCTION path (models/knn.py): one jitted dispatch running
+    # device-side query pack -> kernel -> device-side exact re-rank; its
+    # results and certificate must match both the oracle and the host-side
+    # pack/re-rank pipeline it replaced
+    import jax.numpy as jnp
+
+    nb, k = 7, 5
+    n, m = 3000, 40
+    codes_r = rng.integers(0, nb, size=(n, f)).astype(np.int32)
+    cont_r = rng.random(size=(n, fc)).astype(np.float32)
+    codes_q = rng.integers(0, nb, size=(m, f)).astype(np.int32)
+    cont_q = rng.random(size=(m, fc)).astype(np.float32)
+    with pltpu.force_tpu_interpret_mode():
+        r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
+        d, i, cert = pk.search_fused(
+            codes_q, cont_q, r_mat, jnp.asarray(codes_r),
+            jnp.asarray(cont_r), n_real, nb, k, f + fc)
+        # host-side path on the same operands
+        q_mat, m_real = pk.prepare_queries(codes_q, cont_q, nb)
+        hd2, hidx = pk.topk_candidates(q_mat, r_mat, k)
+    hd, hi, hcert = pk.exact_rerank(hidx[:m_real], hd2[:m_real], codes_q,
+                                    cont_q, codes_r, cont_r, k, f + fc)
+    d, i, cert = np.asarray(d), np.asarray(i), np.asarray(cert)
+    assert cert.all() and hcert.all()
+    od, oi = _oracle(codes_q, cont_q, codes_r, cont_r, k)
+    np.testing.assert_allclose(d, od, atol=2e-5)
+    np.testing.assert_allclose(d, hd, atol=2e-5)
+    if fc:
+        assert (i == oi).mean() == 1.0
+        np.testing.assert_array_equal(i, hi)
+
+
+def test_search_fused_tiny_reference_set(rng):
+    import jax.numpy as jnp
+
+    f, fc, nb, k = 3, 2, 5, 10
+    n, m = 12, 8
+    codes_r = rng.integers(0, nb, size=(n, f)).astype(np.int32)
+    cont_r = rng.random(size=(n, fc)).astype(np.float32)
+    codes_q = rng.integers(0, nb, size=(m, f)).astype(np.int32)
+    cont_q = rng.random(size=(m, fc)).astype(np.float32)
+    with pltpu.force_tpu_interpret_mode():
+        r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
+        d, i, cert = pk.search_fused(
+            codes_q, cont_q, r_mat, jnp.asarray(codes_r),
+            jnp.asarray(cont_r), n_real, nb, k, f + fc)
+    d, i, cert = np.asarray(d), np.asarray(i), np.asarray(cert)
+    assert cert.all()
+    assert (np.asarray(i) < n).all()
+    od, oi = _oracle(codes_q, cont_q, codes_r, cont_r, min(k, n))
+    np.testing.assert_allclose(d[:, :n], od[:, :n], atol=2e-5)
