@@ -127,11 +127,17 @@ def init_distributed(coordinator_address: Optional[str] = None,
     explicitly. Idempotent; returns this host's process index. Single-host
     runs skip initialization entirely.
     """
+    # Probe the distributed-client state WITHOUT touching the backend:
+    # jax.process_count() would itself initialize a single-process backend,
+    # after which jax.distributed.initialize always fails — the join must
+    # come first.
     try:
-        if jax.process_count() > 1:
-            return jax.process_index()      # already initialized
-    except RuntimeError:
-        pass
+        from jax._src import distributed as _dist
+        already = getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        already = False
+    if already:
+        return jax.process_index()          # already joined
     if coordinator_address is None and num_processes is None:
         env = __import__("os").environ
         if not any(k in env for k in
@@ -143,9 +149,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
                                    num_processes=num_processes,
                                    process_id=process_id)
     except RuntimeError:
-        # backend already initialized (e.g. single-host run that touched a
-        # device before calling in) — stay single-process rather than abort
-        return 0
+        # backend already initialized: either a single-host run that
+        # touched a device before calling in, or a repeated call in an
+        # already-joined process (e.g. if the private-state probe above
+        # broke on a JAX upgrade). process_index() reports the truth in
+        # both cases — never assume rank 0.
+        return jax.process_index()
     return jax.process_index()
 
 
@@ -173,14 +182,31 @@ def make_hybrid_mesh(
             if len(shape) < len(axis_names):
                 shape = (len(axis_names) - len(shape)) * (1,) + shape
         return make_mesh(axis_names, shape=shape)
-    from jax.experimental import mesh_utils
     n_local = len(jax.devices()) // num_slices
     if dcn_shape is None:
         dcn_shape = (num_slices,) + (1,) * (len(axis_names) - 1)
     if ici_shape is None:
         ici_shape = (1,) * (len(axis_names) - 1) + (n_local,)
-    devs = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_shape, devices=jax.devices())
+    distinct_slices = {getattr(d, "slice_index", None) for d in jax.devices()}
+    if None not in distinct_slices and len(distinct_slices) == num_slices:
+        from jax.experimental import mesh_utils
+        devs = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=jax.devices())
+    else:
+        # no slice topology on this backend (multi-process CPU run: the
+        # DCN boundary IS the process boundary) — group devices by owning
+        # process, then lay out (dcn..., ici...) and merge axis-wise
+        devs_sorted = sorted(jax.devices(),
+                             key=lambda d: (d.process_index, d.id))
+        arr = np.array(devs_sorted, dtype=object).reshape(
+            tuple(dcn_shape) + tuple(ici_shape))
+        k = len(dcn_shape)
+        perm = []
+        for i in range(k):
+            perm.extend([i, k + i])
+        arr = arr.transpose(perm).reshape(
+            tuple(d * i for d, i in zip(dcn_shape, ici_shape)))
+        devs = arr
     return Mesh(devs, axis_names)
 
 

@@ -66,8 +66,8 @@ def main():
         return agg.nb_mi_pipeline_step(c, l, ci, cj, n_classes, n_bins)
 
     # warmup/compile (forced fetch: block_until_ready is a no-op on the
-    # tunnel platform)
-    out = pipeline_step(dcodes, dlabels)
+    # tunnel platform); warm the chained form the timed loop uses
+    out = pipeline_step(dcodes, dlabels + jnp.int32(0))
     _ = float(out[0].ravel()[0])
 
     # ALL passes are recorded (value = best): the tunnel's dispatch timing
@@ -80,9 +80,15 @@ def main():
     # dispatch and fetches once.
     passes = []
     for _ in range(5):
+        bias = jnp.int32(0)
         t0 = time.perf_counter()
         for _ in range(n_chunks):
-            out = pipeline_step(dcodes, dlabels)
+            # true dependency chain: each dispatch consumes a scalar of the
+            # previous result (via the small labels operand, not the big
+            # codes tensor), so the final fetch is a barrier for ALL chunks
+            # even if the backend could reorder independent dispatches
+            out = pipeline_step(dcodes, dlabels + bias)
+            bias = (out[0][0, 0, 0] * 0).astype(jnp.int32)
         _ = float(out[0].ravel()[0])            # forced device sync
         passes.append(n_chunks * chunk / (time.perf_counter() - t0))
     rows_per_sec = max(passes)

@@ -74,10 +74,15 @@ def main():
     # percent run-to-run).
     dt_serial = float("inf")
     for _ in range(3):
+        bias = jnp.int32(0)
         t0 = time.perf_counter()
         for _ in range(n_blocks):
             d = native.encode_bytes(block, enc, ncols=ncols)
-            out = device_step(jnp.asarray(d.codes), jnp.asarray(d.labels))
+            # dependency chain via the labels operand (BASELINE.md timing
+            # methodology): the final fetch then syncs every block
+            out = device_step(jnp.asarray(d.codes),
+                              jnp.asarray(d.labels) + bias)
+            bias = (out[0][0, 0, 0] * 0).astype(jnp.int32)
         _ = float(out[0].ravel()[0])
         dt_serial = min(dt_serial, time.perf_counter() - t0)
 
@@ -95,9 +100,11 @@ def main():
 
     dt = float("inf")
     for _ in range(3):
+        bias = jnp.int32(0)
         t0 = time.perf_counter()
         for codes, labels in DeviceFeeder(blocks(), depth=2, stage=stage):
-            out = device_step(codes, labels)
+            out = device_step(codes, labels + bias)
+            bias = (out[0][0, 0, 0] * 0).astype(jnp.int32)
         _ = float(out[0].ravel()[0])
         dt = min(dt, time.perf_counter() - t0)
     total = n_blocks * block_rows

@@ -43,12 +43,12 @@ def make_ds(rng, n, f=6, fc=8, nb=10):
         binned_ordinals=list(range(f)), cont_ordinals=list(range(f, f + fc)))
 
 
-def verify_on_chip(model, test, k, n_check=256, row_chunk=16):
+def verify_on_chip(model, test, k, d, n_check=256, row_chunk=16):
     """Exact-vs-oracle certificate on the compiled kernel (hardware path):
-    the first ``n_check`` rows' results must match a float64 numpy oracle.
-    The oracle runs in ``row_chunk``-row slices — a whole-batch broadcast
-    against 1M references would allocate a ~16 GB float64 temp."""
-    d, idx = mknn.nearest_neighbors(model, test, k=k)
+    ``d`` (the [M, k] distances an earlier nearest_neighbors call already
+    produced) must match a float64 numpy oracle on the first ``n_check``
+    rows. The oracle runs in ``row_chunk``-row slices — a whole-batch
+    broadcast against 1M references would allocate a ~16 GB float64 temp."""
     cq_all = mknn._normalize01(test.cont[:n_check], model.cont_lo,
                                model.cont_hi)
     cr = model.cont01().astype(np.float64)
@@ -74,8 +74,8 @@ def main():
     model = mknn.fit_knn(make_ds(rng, n_refs))
     test = make_ds(rng, n_queries)
 
-    mknn.nearest_neighbors(model, test, k=k)        # compile + upload
-    verified = verify_on_chip(model, test, k) if verify else None
+    d_warm, _ = mknn.nearest_neighbors(model, test, k=k)   # compile + upload
+    verified = verify_on_chip(model, test, k, d_warm) if verify else None
 
     # single-shot latency (cold-caller view: every round trip included)
     best = None
@@ -98,15 +98,22 @@ def main():
         batches.append((t.codes,
                         mknn._normalize01(t.cont, model.cont_lo, model.cont_hi)))
     total_attrs = 6 + 8
-    outs = [pallas_knn.search_fused(c, x, r_mat, cr_dev, cx_dev, n, nb, k,
-                                    total_attrs) for c, x in batches[:1]]
-    np.asarray(outs[-1][0])                          # warm + sync
+    outs = [pallas_knn.search_fused(c, x + np.float32(0.0), r_mat, cr_dev,
+                                    cx_dev, n, nb, k, total_attrs)
+            for c, x in batches[:1]]
+    np.asarray(outs[-1][0])                          # warm + sync (chained
+    # form: the timed loop adds a bias scalar to the cont operand)
     passes = []
     for _ in range(3):
+        bias = np.float32(0.0)
         t0 = time.perf_counter()
-        outs = [pallas_knn.search_fused(c, x, r_mat, cr_dev, cx_dev, n, nb,
-                                        k, total_attrs) for c, x in batches]
-        np.asarray(outs[-1][0])                      # device executes in order
+        for c, x in batches:
+            # dependency chain through the tiny cont operand: the final
+            # fetch is then a barrier for every batch, not just the last
+            o = pallas_knn.search_fused(c, x + bias, r_mat, cr_dev, cx_dev,
+                                        n, nb, k, total_attrs)
+            bias = o[0][0, 0] * 0
+        np.asarray(o[0])
         passes.append(len(batches) * n_queries / (time.perf_counter() - t0))
     pipelined = max(passes)
 
@@ -124,7 +131,8 @@ def main():
 
     # roofline: candidate-kernel matmul work per batch
     width = r_mat.shape[1]
-    flops_per_batch = 2.0 * r_mat.shape[0] * ((n_queries + 511) // 512 * 512) * width
+    m_pad = pallas_knn._round_up(max(n_queries, pallas_knn.TM), pallas_knn.TM)
+    flops_per_batch = 2.0 * r_mat.shape[0] * m_pad * width
     batch_dt = n_queries / pipelined
     line = {
         "metric": "knn_qps_1m_refs",

@@ -1,0 +1,77 @@
+"""Worker for the REAL multi-process multi-host test (test_multiprocess.py).
+
+Each process owns 4 virtual CPU devices; jax.distributed.initialize joins
+them into one 8-device run (2 processes = 2 "hosts" over the local
+coordinator — the CPU stand-in for DCN). Exercises the actual multi-host
+code paths: init_distributed, make_hybrid_mesh off its single-slice
+fallback, process_local_batch via make_array_from_process_local_data, and
+the sharded NB/LR SPMD steps whose psums now cross process boundaries.
+"""
+
+import os
+import sys
+
+
+def main():
+    port, pid, nprocs, outdir = sys.argv[1:5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "").replace(
+            "--xla_force_host_platform_device_count=8", "").strip() +
+        " --xla_force_host_platform_device_count=4").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from avenir_tpu.parallel import collectives as coll
+    from avenir_tpu.parallel.mesh import (init_distributed, make_hybrid_mesh,
+                                          process_local_batch)
+
+    idx = init_distributed(coordinator_address=f"localhost:{port}",
+                           num_processes=int(nprocs), process_id=int(pid))
+    assert idx == int(pid), (idx, pid)
+    assert jax.process_count() == int(nprocs), jax.process_count()
+    assert len(jax.local_devices()) == 4
+    assert jax.device_count() == 4 * int(nprocs)
+
+    mesh = make_hybrid_mesh(("data",))
+    assert mesh.shape["data"] == jax.device_count()
+
+    # deterministic GLOBAL dataset; each process feeds only its row range
+    rng = np.random.default_rng(0)
+    n, f, b, c, fc = 4096, 6, 5, 2, 3
+    codes = rng.integers(0, b, size=(n, f), dtype=np.int32)
+    labels = rng.integers(0, c, size=n, dtype=np.int32)
+    cont = rng.random((n, fc)).astype(np.float32)
+    half = n // int(nprocs)
+    lo, hi = idx * half, (idx + 1) * half
+
+    step = coll.sharded_nb_fit_step(mesh, c, b, fc)
+    g_codes = process_local_batch(mesh, codes[lo:hi])
+    g_labels = process_local_batch(mesh, labels[lo:hi])
+    g_cont = process_local_batch(mesh, cont[lo:hi])
+    fbc, cc, _, s1, s2 = step(g_codes, g_labels, g_cont)
+
+    d = 4
+    x = rng.random((n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    w = np.zeros(d, np.float32)
+    lr_step = coll.sharded_lr_step(mesh)
+    g_x = process_local_batch(mesh, x[lo:hi])
+    g_y = process_local_batch(mesh, y[lo:hi])
+    w1 = lr_step(w, g_x, g_y, float(n), 0.5, 0.01)
+    w2 = lr_step(np.asarray(w1), g_x, g_y, float(n), 0.5, 0.01)
+
+    if idx == 0:
+        np.savez(os.path.join(outdir, "result.npz"),
+                 fbc=np.asarray(fbc), cc=np.asarray(cc),
+                 s1=np.asarray(s1), s2=np.asarray(s2),
+                 w2=np.asarray(w2))
+    # every process must agree on the replicated outputs
+    print(f"proc {idx} ok cc={np.asarray(cc).tolist()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
