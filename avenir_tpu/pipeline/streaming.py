@@ -44,9 +44,16 @@ class InProcQueue:
         return self._q.pop() if self._q else None
 
     def drain(self) -> List[str]:
-        out = list(reversed(self._q))
-        self._q.clear()
-        return out
+        # pop-loop, not snapshot+clear: a concurrent push landing between a
+        # snapshot and the clear would be silently lost (deque.pop/append
+        # are individually atomic, so this drains every element exactly
+        # once even with a producer on another thread)
+        out: List[str] = []
+        while True:
+            try:
+                out.append(self._q.pop())
+            except IndexError:
+                return out
 
     def __len__(self) -> int:
         return len(self._q)
@@ -159,12 +166,9 @@ class ReinforcementLearnerServer:
         self.on_log = on_log
         self.processed = 0
 
-    def process_one(self) -> bool:
-        """Handle one event; False when the event queue is empty."""
-        ev = self.events.next_event()
-        if ev is None:
-            return False
-        event_id, round_num = ev
+    def handle(self, event_id: str, round_num: int) -> None:
+        """The per-event body (drain rewards → update → emit actions) —
+        shared by :meth:`process_one` and the ShardedServingFleet workers."""
         for action, reward in self.rewards.read_rewards():
             self.learner.set_reward(action, reward)
         selected = self.learner.next_actions(round_num)
@@ -172,6 +176,13 @@ class ReinforcementLearnerServer:
         self.processed += 1
         if self.log_interval and self.on_log and self.processed % self.log_interval == 0:
             self.on_log(self.processed)
+
+    def process_one(self) -> bool:
+        """Handle one event; False when the event queue is empty."""
+        ev = self.events.next_event()
+        if ev is None:
+            return False
+        self.handle(*ev)
         return True
 
     def run(self, max_events: Optional[int] = None) -> int:
@@ -188,6 +199,96 @@ class ReinforcementLearnerServer:
 
     def restore(self, blob: str) -> None:
         self.learner.set_state(json.loads(blob))
+
+
+# ---------------------------------------------------------------------------
+# parallel serving — the Storm executor-scaling analog
+# ---------------------------------------------------------------------------
+
+class ShardedServingFleet:
+    """Multi-worker event dispatch with per-group learner state — the
+    capacity analog of Storm's topology scaling
+    (ReinforcementLearnerTopology.java:42-85: ``num.bolt.threads`` bolt
+    executors fed by a shuffle, ``num.workers`` JVMs, ``max.spout.pending``
+    backpressure).
+
+    Events carry a group key (the reference reaches the same effect with
+    one topology per engagement group); ``hash(group) % num_workers`` pins
+    every group to one worker — Storm's fieldsGrouping — so each learner
+    updates single-threaded (no lock on the hot path) while distinct groups
+    process concurrently. Each worker owns the servers for its groups,
+    created on first event via ``server_factory(group)``. A bounded
+    per-worker queue (``max_pending``) applies backpressure to the
+    dispatcher exactly like ``max.spout.pending`` caps in-flight tuples.
+
+    ``dispatch`` blocks when the target worker's queue is full; ``close``
+    drains and joins the workers. Results (event_id → actions) flow through
+    each server's own ActionWriter, so any transport (in-proc, Redis)
+    works unchanged.
+    """
+
+    def __init__(self, server_factory: Callable[[str], "ReinforcementLearnerServer"],
+                 num_workers: int = 2, max_pending: int = 128):
+        import queue as _qmod
+        import threading
+
+        self.server_factory = server_factory
+        self.num_workers = max(num_workers, 1)
+        self._queues = [_qmod.Queue(maxsize=max(max_pending, 1))
+                        for _ in range(self.num_workers)]
+        self._servers: List[dict] = [{} for _ in range(self.num_workers)]
+        self._errors: List[BaseException] = []
+        self._threads = []
+        for w in range(self.num_workers):
+            t = threading.Thread(target=self._work, args=(w,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    @property
+    def processed(self) -> int:
+        """Events handled across all workers — summed from the per-server
+        counters each worker owns alone, so the hot path stays lock-free."""
+        return sum(srv.processed for servers in self._servers
+                   for srv in servers.values())
+
+    def _work(self, w: int) -> None:
+        q = self._queues[w]
+        servers = self._servers[w]
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            group, event_id, round_num = item
+            try:
+                srv = servers.get(group)
+                if srv is None:
+                    srv = servers[group] = self.server_factory(group)
+                srv.handle(event_id, round_num)
+            except BaseException as e:       # surfaced on close()
+                self._errors.append(e)
+
+    def dispatch(self, group: str, event_id: str, round_num: int) -> None:
+        """Route one event to its group's worker (blocks on backpressure)."""
+        self._queues[hash(group) % self.num_workers].put(
+            (group, event_id, round_num))
+
+    def close(self) -> None:
+        """Flush queues, stop workers, re-raise the first worker error."""
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join()
+        if self._errors:
+            raise self._errors[0]
+
+    def checkpoints(self) -> dict:
+        """group → learner-state JSON for every group across workers (call
+        after close(), or accept in-flight staleness)."""
+        out = {}
+        for servers in self._servers:
+            for group, srv in servers.items():
+                out[group] = srv.checkpoint()
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Serving-path benchmark: events/sec + action latency through the
+ShardedServingFleet (the Storm-topology capacity analog,
+ReinforcementLearnerTopology.java:42-85). Prints one JSON line.
+
+Workload: G engagement groups, each its own intervalEstimator learner over
+5 actions (the reference runs one topology per group); events round-robin
+the groups; every event drains that group's reward queue and emits an
+action. Reported per worker count (the ``num.bolt.threads`` knob):
+
+- events/sec over the whole stream (dispatch + backpressure + learner
+  update + action write);
+- p50/p99 per-event latency measured at the single-server level (one
+  group, submit → action visible), the serving loop's intrinsic cost.
+
+On the 1-core dev rig thread workers add no parallel speedup (GIL + one
+core); the knob exists for capacity parity and is measured honestly —
+multi-core hosts scale groups across workers.
+"""
+
+import json
+import time
+
+import numpy as np
+
+from avenir_tpu.models import online_rl as orl
+from avenir_tpu.pipeline import streaming as st
+
+ACTIONS = [f"a{i}" for i in range(5)]
+CONF = {"min.reward.distr.sample": 10}
+
+
+def make_server(_group: str) -> st.ReinforcementLearnerServer:
+    learner = orl.create_learner("intervalEstimator", ACTIONS, CONF, seed=3)
+    return st.ReinforcementLearnerServer(
+        learner, st.QueueEventSource(st.InProcQueue()),
+        st.QueueRewardReader(st.InProcQueue()),
+        st.QueueActionWriter(st.InProcQueue()))
+
+
+def fleet_events_per_sec(num_workers: int, n_groups: int = 32,
+                         n_events: int = 40_000) -> float:
+    fleet = st.ShardedServingFleet(make_server, num_workers=num_workers,
+                                   max_pending=256)
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        fleet.dispatch(f"g{i % n_groups}", f"ev{i}", i)
+    fleet.close()
+    dt = time.perf_counter() - t0
+    assert fleet.processed == n_events
+    return n_events / dt
+
+
+def single_event_latencies(n: int = 20_000):
+    srv = make_server("g")
+    events = srv.events.queue
+    actions = srv.actions.queue
+    rewards = srv.rewards.queue
+    rng = np.random.default_rng(0)
+    lats = []
+    for i in range(n):
+        t0 = time.perf_counter()
+        events.push(f"ev{i},{i}")
+        srv.process_one()
+        msg = actions.pop()
+        lats.append(time.perf_counter() - t0)
+        action = msg.split(",")[1]
+        rewards.push(f"{action},{max(rng.normal(50, 10), 0.0)}")
+    return np.asarray(lats)
+
+
+def main():
+    rates = {w: round(fleet_events_per_sec(w), 1) for w in (1, 2, 4)}
+    lats = single_event_latencies()
+    print(json.dumps({
+        "metric": "serving_events_per_sec",
+        "value": max(rates.values()),
+        "unit": "events/sec",
+        "events_per_sec_by_workers": rates,
+        "p50_latency_us": round(float(np.percentile(lats, 50)) * 1e6, 1),
+        "p99_latency_us": round(float(np.percentile(lats, 99)) * 1e6, 1),
+        "groups": 32,
+        "learner": "intervalEstimator",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -588,3 +588,57 @@ def test_streaming_prefetch_feeder_engages_and_matches(churn_env, monkeypatch):
     whole = read_lines(str(root / "nb_whole"))
     assert read_lines(str(root / "nb_stream")) == whole
     assert read_lines(str(root / "nb_noprefetch")) == whole
+
+
+def test_buy_xaction_markov_runbook_loop(tmp_path):
+    # the email-marketing runbook end to end through the file contract:
+    # buy_xaction synthesis -> xaction_seq state sequences ->
+    # MarkovStateTransitionModel job -> mark_plan next-contact dates
+    # (resource/{buy_xaction,xaction_seq,mark_plan}.rb)
+    import datetime
+
+    from avenir_tpu.datagen.buy_xaction import (STATES,
+                                                generate_buy_xactions,
+                                                marketing_plan,
+                                                xactions_to_sequences)
+
+    rows = generate_buy_xactions(300, 180, visitor_percent=0.15, seed=3)
+    assert len(rows) > 3000
+    f = rows[0].split(",")
+    assert len(f) == 4 and f[2].startswith("2013-") and int(f[3]) > 0
+    xids = [int(r.split(",")[1]) for r in rows]
+    assert len(set(xids)) == len(xids)           # unique transaction ids
+
+    seqs = xactions_to_sequences(rows)
+    assert len(seqs) > 100
+    toks = {t for s in seqs for t in s.split(",")[1:]}
+    assert toks <= set(STATES)
+    # planted structure: short-gap repeats of small purchases land near 50,
+    # so SL/SE/SG must all occur; long gaps push amounts up -> LL present
+    assert {"LL"} <= toks and any(t.startswith("S") for t in toks)
+
+    (tmp_path / "seq").mkdir()
+    (tmp_path / "seq" / "part-0").write_text("\n".join(seqs) + "\n")
+    conf = JobConfig({"model.states": ",".join(STATES),
+                      "trans.prob.scale": "100"})
+    get_job("MarkovStateTransitionModel").run(
+        conf, str(tmp_path / "seq"), str(tmp_path / "model"))
+    model_lines = read_lines(str(tmp_path / "model"))
+    # model file: header lines then one int row per state
+    mat = [ln.split(",") for ln in model_lines[-len(STATES):]]
+    assert all(len(r) == len(STATES) for r in mat)
+
+    plan = marketing_plan(rows, mat)
+    assert len(plan) > 100
+    deltas = set()
+    by_cust_last = {}
+    for r in rows:
+        c = r.split(",")
+        by_cust_last[c[0]] = c[2]
+    for ln in plan:
+        cid, nd = [p.strip() for p in ln.split(",")]
+        d = (datetime.date.fromisoformat(nd) -
+             datetime.date.fromisoformat(by_cust_last[cid])).days
+        assert d in (15, 45, 90)
+        deltas.add(d)
+    assert len(deltas) >= 1

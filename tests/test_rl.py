@@ -211,3 +211,58 @@ def test_serving_loop_converges_to_best_page(rng):
         st.QueueActionWriter(actions))
     server2.restore(blob)
     assert learner2.stats["page3"].count == learner.stats["page3"].count
+
+
+def test_sharded_serving_fleet_groups_and_backpressure(rng):
+    # Storm-scaling analog: groups pinned to workers (fieldsGrouping), each
+    # group's learner isolated and converging on ITS reward landscape
+    ctrs = {"gA": {"p1": 20.0, "p2": 80.0}, "gB": {"p1": 90.0, "p2": 10.0},
+            "gC": {"p1": 30.0, "p2": 70.0}}
+    outs = {}
+    rewards_q = {}
+
+    def factory(group):
+        learner = orl.create_learner(
+            "intervalEstimator", list(ctrs[group]),
+            {"min.reward.distr.sample": 10}, seed=5)
+        aq = st.InProcQueue()
+        rq = st.InProcQueue()
+        outs[group] = aq
+        rewards_q[group] = rq
+        srv = st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(st.InProcQueue()),
+            st.QueueRewardReader(rq), st.QueueActionWriter(aq))
+        return srv
+
+    fleet = st.ShardedServingFleet(factory, num_workers=2, max_pending=8)
+    n_rounds = 400
+    for i in range(1, n_rounds + 1):
+        for g in ctrs:
+            fleet.dispatch(g, f"ev{g}{i}", i)
+            # feed a reward for the previous action (async, like the bolt)
+            q = outs.get(g)
+            if q is not None and len(q):
+                _, action = q.pop().split(",")
+                mu = ctrs[g][action]
+                rewards_q[g].push(f"{action},{max(rng.normal(mu, 8), 0.0)}")
+    fleet.close()
+    assert fleet.processed == n_rounds * len(ctrs)
+    # per-group learners learned their OWN optimum
+    cps = fleet.checkpoints()
+    assert set(cps) == set(ctrs)
+    import json as _json
+    for g, blob in cps.items():
+        state = _json.loads(blob)
+        best = max(ctrs[g], key=ctrs[g].get)
+        counts = {a: len(r) for a, r in state["rewards"].items()}
+        assert counts[best] == max(counts.values()), (g, counts)
+
+
+def test_sharded_serving_fleet_error_surfaces():
+    def factory(group):
+        raise RuntimeError("factory boom")
+
+    fleet = st.ShardedServingFleet(factory, num_workers=1)
+    fleet.dispatch("g", "ev1", 1)
+    with pytest.raises(RuntimeError, match="factory boom"):
+        fleet.close()
