@@ -133,3 +133,59 @@ def test_search_fused_tiny_reference_set(rng):
     assert (np.asarray(i) < n).all()
     od, oi = _oracle(codes_q, cont_q, codes_r, cont_r, min(k, n))
     np.testing.assert_allclose(d[:, :n], od[:, :n], atol=2e-5)
+
+
+def test_search_fused_block2_path_matches_oracle(rng):
+    # enough reference blocks to engage the block top-2 sweep
+    # (2*nblocks >= k+margin) — the production path at scale; verify exact
+    # results + certificate against the oracle
+    import jax.numpy as jnp
+
+    f, fc, nb, k = 5, 6, 8, 5
+    n, m = 70_000, 24
+    codes_r = rng.integers(0, nb, size=(n, f)).astype(np.int32)
+    cont_r = rng.random(size=(n, fc)).astype(np.float32)
+    codes_q = rng.integers(0, nb, size=(m, f)).astype(np.int32)
+    cont_q = rng.random(size=(m, fc)).astype(np.float32)
+    with pltpu.force_tpu_interpret_mode():
+        r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
+        assert 2 * (r_mat.shape[0] // pk.TN) >= k + pk.MARGIN
+        d, i, cert = pk.search_fused(
+            codes_q, cont_q, r_mat, jnp.asarray(codes_r),
+            jnp.asarray(cont_r), n_real, nb, k, f + fc)
+    d, i, cert = np.asarray(d), np.asarray(i), np.asarray(cert)
+    od, oi = _oracle(codes_q, cont_q, codes_r, cont_r, k)
+    ok = cert
+    assert ok.mean() > 0.9            # uniform data: failures are rare
+    np.testing.assert_allclose(d[ok], od[ok], atol=2e-5)
+    assert (i[ok] == oi[ok]).mean() == 1.0
+
+
+def test_search_fused_block2_short_last_block_not_falsely_certified(rng):
+    # regression: n_real = 8*TN+1 puts one real ref in the last block, so a
+    # pad lands in the candidate pool; that must NOT certify rows (the
+    # merge-kernel "pad => all refs seen" invariant does not hold here —
+    # blocks still hide non-candidates). Exactness comes from the fallback.
+    import jax.numpy as jnp
+
+    f, fc, nb, k = 4, 3, 6, 10
+    n = 8 * pk.TN + 1
+    m = 16
+    codes_r = rng.integers(0, nb, size=(n, f)).astype(np.int32)
+    cont_r = rng.random(size=(n, fc)).astype(np.float32)
+    codes_q = rng.integers(0, nb, size=(m, f)).astype(np.int32)
+    cont_q = rng.random(size=(m, fc)).astype(np.float32)
+    with pltpu.force_tpu_interpret_mode():
+        r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
+        assert 2 * (r_mat.shape[0] // pk.TN) >= k + pk.MARGIN  # block2 path
+        d, i, cert = pk.search_fused(
+            codes_q, cont_q, r_mat, jnp.asarray(codes_r),
+            jnp.asarray(cont_r), n_real, nb, k, f + fc)
+    cert = np.asarray(cert)
+    od, oi = _oracle(codes_q, cont_q, codes_r, cont_r, k)
+    # with only 18 candidates over 16k+ refs nothing should certify; any
+    # certified row MUST actually be exact
+    ok = cert
+    if ok.any():
+        np.testing.assert_allclose(np.asarray(d)[ok], od[ok], atol=2e-5)
+    assert (~cert).any()
