@@ -214,11 +214,14 @@ def sharded_mi_step(mesh: Mesh, num_classes: int, num_bins: int,
     def step(codes, labels, ci, cj):
         _check_chunk(codes)            # per-shard f32 exact-accumulation cap
         oh_c = _onehot(labels, num_classes)            # [n_loc, C]
-        # local slice of the pair list: gather both columns per local pair
-        oh_i = _onehot(jnp.take(codes, ci, axis=1), num_bins)  # [n_loc, P_loc, B]
-        oh_j = _onehot(jnp.take(codes, cj, axis=1), num_bins)
-        pabc = jnp.einsum("npa,npb,nc->pabc", oh_i, oh_j, oh_c,
-                          precision="highest").astype(jnp.int32)
+        # local slice of the pair list: gather both columns per local pair,
+        # then the SAME two-operand joint (bin_j, class) kernel the
+        # single-device path uses (ops/agg.py::pair_class_counts — 2.3× the
+        # three-operand einsum on-chip, drop-invalid labels preserved)
+        from avenir_tpu.ops.agg import pair_class_counts
+        pabc = pair_class_counts(jnp.take(codes, ci, axis=1),
+                                 jnp.take(codes, cj, axis=1),
+                                 labels, num_classes, num_bins)
         fbc = jnp.einsum("nfb,nc->fbc", _onehot(codes, num_bins), oh_c,
                          precision="highest").astype(jnp.int32)
         cc = jnp.sum(oh_c, axis=0).astype(jnp.int32)

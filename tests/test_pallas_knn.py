@@ -189,3 +189,54 @@ def test_search_fused_block2_short_last_block_not_falsely_certified(rng):
     if ok.any():
         np.testing.assert_allclose(np.asarray(d)[ok], od[ok], atol=2e-5)
     assert (~cert).any()
+
+
+def test_search_fused_block2_heavy_ties_and_duplicates(rng):
+    # adversarial for the block top-2 sweep: many duplicated reference rows
+    # (ties across and within blocks) — certified rows must still be exact
+    import jax.numpy as jnp
+
+    f, fc, nb, k = 4, 2, 5, 5
+    base = rng.integers(0, nb, size=(500, f)).astype(np.int32)
+    codes_r = np.tile(base, (160, 1))[:70_000]          # heavy duplication
+    cont_base = rng.random(size=(500, fc)).astype(np.float32)
+    cont_r = np.tile(cont_base, (160, 1))[:70_000]
+    m = 16
+    codes_q = rng.integers(0, nb, size=(m, f)).astype(np.int32)
+    cont_q = rng.random(size=(m, fc)).astype(np.float32)
+    with pltpu.force_tpu_interpret_mode():
+        r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
+        assert 2 * (r_mat.shape[0] // pk.TN) >= k + pk.MARGIN
+        d, i, cert = pk.search_fused(
+            codes_q, cont_q, r_mat, jnp.asarray(codes_r),
+            jnp.asarray(cont_r), n_real, nb, k, f + fc)
+    d, cert = np.asarray(d), np.asarray(cert)
+    od, _ = _oracle(codes_q, cont_q, codes_r, cont_r, k)
+    # distances (not indices — ties) must match the oracle on certified rows
+    np.testing.assert_allclose(d[cert], od[cert], atol=2e-5)
+    # non-vacuity: with massive duplication the k-th and (k+1)-th distances
+    # tie, so the bound-based certificate must actually refuse some rows —
+    # the fallback (exercised at the model level) covers them
+    assert (~cert).any()
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.models import knn as mknn
+    model = mknn.fit_knn(EncodedDataset(
+        codes=codes_r, cont=cont_r,
+        labels=np.zeros(len(codes_r), np.int32), ids=None,
+        n_bins=np.full(f, nb, np.int32), class_values=["a"],
+        binned_ordinals=list(range(f)),
+        cont_ordinals=list(range(f, f + fc))))
+    test = EncodedDataset(
+        codes=codes_q, cont=cont_q, labels=None, ids=None,
+        n_bins=np.full(f, nb, np.int32), class_values=["a"],
+        binned_ordinals=list(range(f)),
+        cont_ordinals=list(range(f, f + fc)))
+    with pltpu.force_tpu_interpret_mode():
+        dm, _ = mknn.nearest_neighbors(model, test, k=k)
+    # model-level oracle over the TRAIN-range-normalized continuous values
+    on, _ = _oracle(codes_q,
+                    mknn._normalize01(cont_q, model.cont_lo, model.cont_hi),
+                    codes_r,
+                    mknn._normalize01(cont_r, model.cont_lo, model.cont_hi),
+                    k)
+    np.testing.assert_allclose(dm, on, atol=2e-5)   # every row exact
