@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from avenir_tpu.ops.agg import _check_chunk, one_hot as _onehot
+from avenir_tpu.ops.agg import (_check_chunk, one_hot as _onehot,
+                                pair_class_counts)
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     from jax import shard_map as _shard_map
@@ -218,7 +219,6 @@ def sharded_mi_step(mesh: Mesh, num_classes: int, num_bins: int,
         # then the SAME two-operand joint (bin_j, class) kernel the
         # single-device path uses (ops/agg.py::pair_class_counts — 2.3× the
         # three-operand einsum on-chip, drop-invalid labels preserved)
-        from avenir_tpu.ops.agg import pair_class_counts
         pabc = pair_class_counts(jnp.take(codes, ci, axis=1),
                                  jnp.take(codes, cj, axis=1),
                                  labels, num_classes, num_bins)

@@ -19,6 +19,30 @@ import jax
 import numpy as np
 
 
+def device_sync(value):
+    """Reliable device barrier: fetch one scalar PER SHARD of ``value``.
+
+    ``jax.block_until_ready`` is a NO-OP on some PJRT transports (measured
+    on the dev tunnel — BASELINE.md "Timing methodology"), so timing code
+    must force a host read of the result instead. One scalar is read from
+    every addressable shard — fetching only element 0 would wait for the
+    device holding shard 0 while the rest of a sharded result is still
+    computing (and a global multi-host array is not eagerly indexable at
+    all). Works on any pytree of arrays; returns ``value`` unchanged."""
+    leaves = [x for x in jax.tree_util.tree_leaves(value)
+              if hasattr(x, "dtype") and getattr(x, "size", 0)]
+    for x in leaves:
+        shards = getattr(x, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                d = sh.data
+                if getattr(d, "size", 0):
+                    np.asarray(jax.device_get(d.ravel()[0] if d.ndim else d))
+        else:
+            np.asarray(jax.device_get(x.ravel()[0] if x.ndim else x))
+    return value
+
+
 @contextlib.contextmanager
 def trace(log_dir: Optional[str]):
     """Capture an XLA/device trace under ``log_dir`` (no-op when None)."""
@@ -55,7 +79,8 @@ class StepTimer:
         self._pending = None
         yield self
         if self._pending is not None:
-            jax.block_until_ready(self._pending)
+            device_sync(self._pending)     # a host fetch, not
+            # block_until_ready: the latter is a no-op on some transports
             self._pending = None
         self.samples.setdefault(name, []).append(
             (time.perf_counter() - start) * 1e3)

@@ -65,10 +65,11 @@ def main():
     def pipeline_step(c, l):
         return agg.nb_mi_pipeline_step(c, l, ci, cj, n_classes, n_bins)
 
-    # warmup/compile (forced fetch: block_until_ready is a no-op on the
-    # tunnel platform); warm the chained form the timed loop uses
-    out = pipeline_step(dcodes, dlabels + jnp.int32(0))
-    _ = float(out[0].ravel()[0])
+    # warmup/compile (device_sync = per-shard host fetch: block_until_ready
+    # is a no-op on the tunnel platform); warm the chained form the timed
+    # loop uses
+    from avenir_tpu.utils.profiling import device_sync
+    device_sync(pipeline_step(dcodes, dlabels + jnp.int32(0)))
 
     # ALL passes are recorded (value = best): the tunnel's dispatch timing
     # jitters run-to-run by tens of percent (BASELINE.md), so a single
@@ -89,7 +90,7 @@ def main():
             # even if the backend could reorder independent dispatches
             out = pipeline_step(dcodes, dlabels + bias)
             bias = (out[0][0, 0, 0] * 0).astype(jnp.int32)
-        _ = float(out[0].ravel()[0])            # forced device sync
+        device_sync(out)
         passes.append(n_chunks * chunk / (time.perf_counter() - t0))
     rows_per_sec = max(passes)
 

@@ -56,9 +56,9 @@ def main():
 
     # warm up compile + native path (sync = host fetch; block_until_ready
     # is a no-op on the tunnel platform — BASELINE.md timing methodology)
+    from avenir_tpu.utils.profiling import device_sync
     d = native.encode_bytes(block, enc, ncols=ncols)
-    out = device_step(jnp.asarray(d.codes), jnp.asarray(d.labels))
-    _ = float(out[0].ravel()[0])
+    device_sync(device_step(jnp.asarray(d.codes), jnp.asarray(d.labels)))
 
     # ingest-only rate (best of 3, matching knn_qps.py)
     ingest_dt = float("inf")
@@ -83,7 +83,7 @@ def main():
             out = device_step(jnp.asarray(d.codes),
                               jnp.asarray(d.labels) + bias)
             bias = (out[0][0, 0, 0] * 0).astype(jnp.int32)
-        _ = float(out[0].ravel()[0])
+        device_sync(out)
         dt_serial = min(dt_serial, time.perf_counter() - t0)
 
     # end-to-end through the DeviceFeeder — the path the streaming jobs use
@@ -105,7 +105,7 @@ def main():
         for codes, labels in DeviceFeeder(blocks(), depth=2, stage=stage):
             out = device_step(codes, labels + bias)
             bias = (out[0][0, 0, 0] * 0).astype(jnp.int32)
-        _ = float(out[0].ravel()[0])
+        device_sync(out)
         dt = min(dt, time.perf_counter() - t0)
     total = n_blocks * block_rows
 

@@ -691,3 +691,19 @@ def test_failed_native_build_leaves_no_partial_artifact(tmp_path):
     assert sorted(os.listdir(src_dir)) == ["csv_encode.cpp"] or \
         sorted(n for n in os.listdir(src_dir) if not n.endswith(".lock")) == \
         ["csv_encode.cpp"]
+
+
+def test_device_sync_forces_result_and_passes_through():
+    import jax.numpy as jnp
+
+    from avenir_tpu.utils.profiling import StepTimer, device_sync
+
+    x = jnp.arange(8.0)
+    out = device_sync({"a": x * 2, "b": None and x})
+    np.testing.assert_allclose(np.asarray(out["a"]), np.arange(8.0) * 2)
+
+    timer = StepTimer()
+    with timer.step("s") as t:
+        t.block_on(jnp.ones((4, 4)) @ jnp.ones((4, 4)))
+    s = timer.summary()["s"]
+    assert s["count"] == 1 and s["mean_ms"] >= 0.0
