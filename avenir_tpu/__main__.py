@@ -91,3 +91,8 @@ def main(argv: List[str]) -> int:
 
 if __name__ == "__main__":
     raise SystemExit(main(sys.argv[1:]))
+
+
+def cli() -> None:
+    """console-script entry point (pyproject.toml [project.scripts])."""
+    raise SystemExit(main(sys.argv[1:]))

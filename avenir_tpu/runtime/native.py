@@ -19,7 +19,27 @@ from typing import List, Optional
 import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "native", "csv_encode.cpp")
-_LIB = os.path.join(os.path.dirname(__file__), "native", "libavenir_native.so")
+
+
+def _lib_path() -> str:
+    """Where the compiled library lives: next to the source when that
+    directory is writable (repo checkouts — keeps the prebuilt .so in
+    place), else a per-user cache dir (pip installs into read-only
+    site-packages must not silently lose the native fast path)."""
+    pkg_dir = os.path.join(os.path.dirname(__file__), "native")
+    pkg_lib = os.path.join(pkg_dir, "libavenir_native.so")
+    if os.path.exists(pkg_lib) and \
+            os.path.getmtime(pkg_lib) >= os.path.getmtime(_SRC):
+        return pkg_lib                 # shipped/prebuilt and current
+    if os.access(pkg_dir, os.W_OK):
+        return pkg_lib
+    cache = os.path.join(os.path.expanduser("~"), ".cache", "avenir_tpu",
+                         "native")
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, "libavenir_native.so")
+
+
+_LIB = _lib_path()
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
