@@ -346,15 +346,16 @@ def nearest_neighbors(
     """([M, k] distances, [M, k] reference indices), ascending by distance.
 
     ``mode="exact"`` (default): on TPU backends the euclidean metric
-    dispatches to the fused Pallas kernel (exact, ~2× the XLA scan at 1M
-    refs — BASELINE.md); everything else uses the compiled XLA tile scan.
-    ``mode="approx"``: per-tile ``lax.approx_min_k`` with an exact
-    cross-tile merge — measured 13.3-14.3k QPS at 0.9988 end-to-end recall
-    (1M refs, k=10) vs ~7.6-9.8k for the exact XLA scan and ~13.7k for the
-    fused Pallas exact path (comparable, within timing noise). Worthwhile
-    where the Pallas kernel cannot run (manhattan metric, k > kernel
-    slots, non-TPU backends); a capability knob the reference has no
-    analog for, OFF unless asked for."""
+    dispatches to the fused Pallas search (block top-2 sweep + exact
+    re-rank, ~9× the XLA scan at 1M refs — BASELINE.md); everything else
+    uses the compiled XLA tile scan. ``mode="approx"``: a quality floor,
+    not a method — when the fused exact path applies it is BOTH faster and
+    exact, so an approx request routes there (≥-quality results, like the
+    sharded route below); only configurations the kernel cannot serve
+    (manhattan metric, k > kernel slots, non-TPU backends) run the
+    per-tile ``lax.approx_min_k`` + exact cross-tile merge (0.9988
+    measured end-to-end recall at 1M refs, k=10) — a capability knob the
+    reference has no analog for, OFF unless asked for."""
     if mode not in ("exact", "approx"):
         raise ValueError(f"unknown search mode {mode!r}; use exact|approx")
     if mesh is not None and mesh.shape.get("data", 1) > 1:
@@ -365,11 +366,11 @@ def nearest_neighbors(
                                                  mesh.shape["data"]):
             return _nearest_neighbors_sharded(model, test, k, metric, mesh,
                                               test_tile, ref_tile)
+    if _pallas_available(metric, k) and min(k, model.num_refs) == k:
+        return _nearest_neighbors_pallas(model, test, k)
     if mode == "approx":
         return _nearest_neighbors_xla(model, test, k, metric, ref_tile,
                                       test_tile, approx=True)
-    if _pallas_available(metric, k) and min(k, model.num_refs) == k:
-        return _nearest_neighbors_pallas(model, test, k)
     return _nearest_neighbors_xla(model, test, k, metric, ref_tile, test_tile)
 
 

@@ -20,12 +20,19 @@ import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "native", "csv_encode.cpp")
 
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
 
 def _lib_path() -> str:
     """Where the compiled library lives: next to the source when that
     directory is writable (repo checkouts — keeps the prebuilt .so in
     place), else a per-user cache dir (pip installs into read-only
-    site-packages must not silently lose the native fast path)."""
+    site-packages must not silently lose the native fast path). The cache
+    filename embeds a hash of the source so a package upgrade can never be
+    served a stale-ABI build (mtime comparison is unreliable there —
+    wheel extraction preserves archive timestamps)."""
     pkg_dir = os.path.join(os.path.dirname(__file__), "native")
     pkg_lib = os.path.join(pkg_dir, "libavenir_native.so")
     if os.path.exists(pkg_lib) and \
@@ -33,17 +40,20 @@ def _lib_path() -> str:
         return pkg_lib                 # shipped/prebuilt and current
     if os.access(pkg_dir, os.W_OK):
         return pkg_lib
+    import hashlib
+    with open(_SRC, "rb") as fh:
+        tag = hashlib.sha1(fh.read()).hexdigest()[:12]
     cache = os.path.join(os.path.expanduser("~"), ".cache", "avenir_tpu",
                          "native")
     os.makedirs(cache, exist_ok=True)
-    return os.path.join(cache, "libavenir_native.so")
+    return os.path.join(cache, f"libavenir_native-{tag}.so")
 
 
-_LIB = _lib_path()
-
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_error: Optional[str] = None
+try:
+    _LIB: Optional[str] = _lib_path()
+except OSError as e:                   # e.g. unwritable/absent HOME: the
+    _LIB = None                        # native path is OPTIONAL — degrade,
+    _build_error = str(e)              # never crash the import
 
 _ERRORS = {
     -1: "ragged CSV record",
@@ -58,6 +68,8 @@ KIND_CATEGORICAL, KIND_BINNED_NUMERIC, KIND_CONTINUOUS, KIND_LABEL, KIND_ID = \
 
 def _build() -> Optional[ctypes.CDLL]:
     global _build_error
+    if _LIB is None:                   # no writable location for the build
+        return None
     if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
         return ctypes.CDLL(_LIB)
     # two processes importing concurrently must not both write the .so:
