@@ -162,18 +162,40 @@ class MutualInformation:
         pair_index = np.array([(i, j) for i in range(f) for j in range(i + 1, f)],
                               np.int32).reshape(-1, 2)
         acc = agg.Accumulator()
+        # single-TPU fast path: one MXU co-occurrence kernel per chunk
+        # (ops/pallas_hist.py, ~4-5× the einsum form) accumulates the
+        # [Wp, Wp] G matrix; the [F,B,C] tensor and every pair's [B,B,C]
+        # joint are read out of the int64 G total ONCE at the end on host
+        # (device-side extraction measured slower than the kernel itself).
+        # The einsum loop stays for meshes (its psum is the attested
+        # collective), wide tables, and CPU runs — bit-identical counts.
+        from avenir_tpu.ops import pallas_hist
+        fast = (self.mesh is None and pallas_hist.applicable(f, b, c)
+                and pallas_hist.on_tpu_single_device())
         for ds in chunks:
             from avenir_tpu.parallel.mesh import maybe_shard_batch
             codes, labels = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             acc.add("class", agg.class_counts(labels, c))
+            if fast:
+                acc.add("g", pallas_hist.cooc_counts(codes, labels, b, c))
+                continue
             acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
             for s in range(0, len(pair_index), self.pair_chunk):
                 sl = pair_index[s:s + self.pair_chunk]
                 pcc = agg.pair_class_counts(
                     codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b)
                 acc.add(f"pcc{s}", pcc)
-        pcc_full = (np.concatenate([acc.get(f"pcc{s}") for s in range(0, len(pair_index), self.pair_chunk)])
-                    if len(pair_index) else np.zeros((0, b, b, c), np.int64))
+        if "g" in acc:
+            fc_full, pcc_full = pallas_hist.counts_from_cooc(
+                acc.get("g"), f, b, c, pair_index[:, 0], pair_index[:, 1])
+        elif len(pair_index):
+            fc_full = acc.get("fc")
+            pcc_full = np.concatenate(
+                [acc.get(f"pcc{s}")
+                 for s in range(0, len(pair_index), self.pair_chunk)])
+        else:
+            fc_full = acc.get("fc")
+            pcc_full = np.zeros((0, b, b, c), np.int64)
         names = list(feature_names) if feature_names is not None else [
             f"f{o}" for o in meta.binned_ordinals]
         return MutualInfoResult(
@@ -181,7 +203,7 @@ class MutualInformation:
             class_values=list(meta.class_values),
             n_bins=np.asarray(meta.n_bins, np.int64),
             class_counts=acc.get("class"),
-            feature_class_counts=acc.get("fc"),
+            feature_class_counts=fc_full,
             pair_index=pair_index,
             pair_class_counts=pcc_full,
         ).finish()

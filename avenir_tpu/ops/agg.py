@@ -95,10 +95,18 @@ def pair_counts(
 
 @functools.partial(jax.jit, static_argnames=("num_classes", "num_bins"))
 def nb_mi_pipeline_step(codes, labels, ci, cj, num_classes: int, num_bins: int):
-    """The benchmark-defining NB+MI aggregation step: class-conditional bin
+    """The NB+MI aggregation step in its einsum form: class-conditional bin
     counts plus all feature-pair-class joint counts in ONE einsum dispatch.
-    Shared by bench.py and benchmarks/e2e_pipeline.py so the primary and
-    end-to-end metrics always measure identical work.
+
+    Round 3: on a single TPU device with a small joint table this is no
+    longer the primary path — ``ops/pallas_hist.cooc_counts`` (G = XᵀX over
+    the joint (feature, bin, class) one-hot, built in VMEM, int8 MXU pass)
+    measures ~4-5× faster, and MutualInformation.fit / bench.py /
+    benchmarks/e2e_pipeline.py route to it explicitly (host-side read-out
+    of the same tensors via ``pallas_hist.counts_from_cooc``;
+    bit-identical int32 counts).  This form remains the multi-device path
+    (its data-axis psum is the attested collective), the wide-table path
+    (F·B·C > pallas_hist.MAX_W), and the CPU/test path.
 
     The F diagonal "pairs" (f, f) are appended to the P requested pairs: the
     [a, a, c] diagonal of a (f, f) joint IS the class-conditional bin count,

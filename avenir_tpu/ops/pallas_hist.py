@@ -1,0 +1,219 @@
+"""MXU co-occurrence histogram — the Pallas count kernel behind NB+MI.
+
+The count tables of the flagship pipeline (the rebuild of the reference's
+``explore/MutualInformation.java:236-403`` combiner/reducer and
+``bayesian/BayesianDistribution.java:203-328`` shuffle) were previously
+one-hot einsums that XLA lowers to scatter-adds — measured wall of
+~7 G updates/s (66 updates/row on the hosp_readmit shape, <1% of any
+hardware peak; BASELINE.md round-2 perf notes).  This kernel replaces the
+scatter lowering entirely:
+
+    every NB/MI count table is a sub-block of  G = Xᵀ X,
+    where X is the [N, W] one-hot of the joint (feature, bin, class) code,
+    W = F·B·C.
+
+X is never materialized in HBM (round 2 measured the dense-matmul-with-
+HBM-one-hot form traffic-bound and slower than scatter).  Instead the
+kernel streams the [F, N] int32 joint-code array through VMEM in column
+blocks, expands each block to Xᵀ in registers/VMEM (tile-concatenate +
+compare — no gather), and feeds the int8 MXU path, accumulating G in an
+int32 [Wp, Wp] VMEM block across the grid:
+
+    joint  [F, BN]  --tile x JC-->  [W, BN]  ==iota//F==>  Xᵀ int8
+    G += Xᵀ·X      (int8 MXU pass, int32 accumulate — exact)
+
+Layout: G's row/col index is j-major, ``w = (bin·C + class)·F + feature``
+— the native order of a tile-style repeat (result row w = input row
+w mod F).  :func:`nb_mi_step` re-indexes G into the reference-shaped
+[F, B, C] and [P, B, B, C] tensors.
+
+Measured round 3 (TPU v5 lite, chained-dispatch host-fetch sync,
+16M-row chunks, hosp_readmit shape F=11 B=12 C=2, Wp=384):
+~480-500 M rows/s vs ~80-113 M for the einsum/scatter form — the kernel
+is int8-MXU-bound (the Xᵀ·X pass alone is ~12.6 ms of the ~34 ms/chunk;
+the rest is the VPU expand/compare at W·N cells), not HBM-bound: the
+[F, N] int32 joint stream it reads is 44 B/row ≈ 18 GB/s at this rate,
+so the roofline resource is MXU occupancy, not bandwidth.
+
+Exactness: int8 operands are 0/1, int32 accumulation — per-chunk counts
+are exact up to 2^31 rows (the einsum path's f32 accumulation capped
+chunks at 2^24; callers keep that cap so both paths stay interchangeable).
+Out-of-range codes produce joint codes outside [0, B·C) and drop out, and
+out-of-range labels invalidate the whole row — bit-identical semantics to
+``ops/agg.py::pair_class_counts``'s drop-invalid contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# joint-code marker for invalid rows / padding: never equals a selector
+# value (selectors are in [0, B·C) plus the pad marker below)
+_INVALID = -(1 << 20)
+_PAD_SEL = -(1 << 20) - 1
+
+# The Xᵀ·X pass costs ~2·Wp² int8-MXU FLOP per row; past Wp≈768 the kernel
+# loses to the scatter einsum (and VMEM for the [Wp, BN] expansion runs
+# out), so the dispatcher falls back above this.
+MAX_W = 768
+
+# column-block default: ~500 M rows/s optimum on v5e for Wp=384 (sweep in
+# round-3 notes); scaled down by the wrapper for wider tables
+_DEFAULT_BN = 49152
+
+
+def _ru(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def default_block_cols(wp: int) -> int:
+    """Column block sized so the [wp, BN] int32 expansion + int8 one-hot
+    stay inside the ~110 MB VMEM budget the kernel compiles against."""
+    bn = _DEFAULT_BN * 384 // max(wp, 128)
+    return max(128, (bn // 128) * 128)
+
+
+def _cooc_kernel(joint_ref, out_ref, *, f: int, jc: int, w: int, wp: int,
+                 n: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    joint = joint_ref[:]                               # [F, BN] int32
+    bn = joint.shape[1]
+    # ragged tail: lanes past the true row count read garbage from the
+    # out-of-bounds block — neutralize them here instead of paying a
+    # full-array jnp.pad copy outside (~10 ms/chunk at 16M rows)
+    if n % bn:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+        joint = jnp.where(lane < n - i * bn, joint, _INVALID)
+    # tile-expand: row w of the result is joint[w mod F] (jnp.concatenate
+    # measures identical to pltpu.repeat on-chip and also lowers in
+    # interpreter mode for the CPU test suite)
+    jrept = jnp.concatenate([joint] * jc, axis=0)      # [W, BN]
+    if wp > w:
+        jrept = jnp.concatenate(
+            [jrept, jnp.full((wp - w, bn), _INVALID, jnp.int32)], axis=0)
+    jw = jax.lax.broadcasted_iota(jnp.int32, (wp, 1), 0)
+    jsel = jnp.where(jw < w, jw // f, _PAD_SEL)
+    # int8 one-hot straight from the int32 compare: int8 compare/select is
+    # not lowerable (Mosaic), int32→int8 select is — and feeds the int8
+    # MXU pass at 2× the bf16 rate
+    xt = (jrept == jsel).astype(jnp.int8)              # [Wp, BN] = Xᵀ block
+    acc = jax.lax.dot_general(xt, xt, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out_ref[:] += acc
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_bins", "num_classes", "block_cols", "interpret"))
+def cooc_counts(codes: jax.Array, labels: jax.Array, num_bins: int,
+                num_classes: int, *, block_cols: int | None = None,
+                interpret: bool = False) -> jax.Array:
+    """codes [N, F] int, labels [N] int → G [Wp, Wp] int32 co-occurrence
+    counts in j-major layout (``w = (bin·C + class)·F + feature``).
+
+    G[w1, w2] = #rows whose feature f1 falls in (b1, c) and f2 in (b2, c)
+    — all NB/MI count tables at once.  Cross-class blocks are zero by
+    construction (a row has one label)."""
+    n, f = codes.shape
+    jc = num_bins * num_classes
+    w = f * jc
+    wp = _ru(w, 128)
+    bn = block_cols or default_block_cols(wp)
+    y = labels[None, :]
+    valid = (y >= 0) & (y < num_classes)
+    joint = jnp.where(valid, codes.T.astype(jnp.int32) * num_classes + y,
+                      _INVALID)                        # [F, N]
+    npad = _ru(max(n, bn), bn)
+    return pl.pallas_call(
+        functools.partial(_cooc_kernel, f=f, jc=jc, w=w, wp=wp, n=n),
+        grid=(npad // bn,),
+        in_specs=[pl.BlockSpec((f, bn), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((wp, wp), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((wp, wp), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(joint)
+
+
+def counts_from_cooc(g, num_feat: int, num_bins: int, num_classes: int,
+                     ci, cj):
+    """Host-side (numpy) read-out of the reference-shaped count tensors
+    from G:  → (fbc [F, B, C], pair [P, B, B, C]), dtype preserved.
+
+    This runs ONCE per job on a ~100 KB–1 MB matrix (microseconds of
+    numpy) — on-device extraction was measured at 20-30 ms/call on the
+    dev TPU (every gather / diagonal / batched-einsum formulation lowers
+    to scalar loops or pathological small batched GEMMs), i.e. slower
+    than the count kernel itself, so the device hands back G and the host
+    does the indexing."""
+    import numpy as np
+    g = np.asarray(g)
+    f, b, c = num_feat, num_bins, num_classes
+    w = f * b * c
+    ci = np.asarray(ci, np.int64)
+    cj = np.asarray(cj, np.int64)
+    # w = (bin·C + class)·F + feature  (j-major kernel layout)
+    a_ = np.arange(b)[None, :, None]
+    c_ = np.arange(c)[None, None, :]
+    wf = (a_ * c + c_) * f + np.arange(f)[:, None, None]     # [F, B, C]
+    fbc = g[wf, wf]
+    grid_a = (np.arange(b)[None, :, None, None] * c
+              + np.arange(c)[None, None, None, :]) * f       # [1, B, 1, C]
+    grid_b = (np.arange(b)[None, None, :, None] * c
+              + np.arange(c)[None, None, None, :]) * f       # [1, 1, B, C]
+    idx1 = grid_a + ci[:, None, None, None]                  # [P, B, 1, C]
+    idx2 = grid_b + cj[:, None, None, None]                  # [P, 1, B, C]
+    p = len(ci)
+    pair = g[np.broadcast_to(idx1, (p, b, b, c)),
+             np.broadcast_to(idx2, (p, b, b, c))]
+    return fbc, pair
+
+
+def nb_mi_step(codes: jax.Array, labels: jax.Array, ci, cj,
+               num_classes: int, num_bins: int, *, interpret: bool = False):
+    """Kernel-backed equivalent of
+    :func:`avenir_tpu.ops.agg.nb_mi_pipeline_step`:
+    → (fbc [F, B, C] int32, pair [P, B, B, C] int32) as numpy arrays.
+
+    Synchronizes (fetches G) — callers that need async chaining should
+    run :func:`cooc_counts` per chunk and :func:`counts_from_cooc` once at
+    the end, which is how MutualInformation.fit and bench.py use it."""
+    g = cooc_counts(codes, labels, num_bins, num_classes,
+                    interpret=interpret)
+    return counts_from_cooc(g, codes.shape[1], num_bins, num_classes, ci, cj)
+
+
+def applicable(num_feat: int, num_bins: int, num_classes: int) -> bool:
+    """Static shape gate: is the Xᵀ·X form profitable/compilable here?"""
+    return 0 < num_feat * num_bins * num_classes <= MAX_W
+
+
+def on_tpu_single_device(*arrays) -> bool:
+    """Runtime gate: default backend is a TPU and no operand is sharded
+    across devices (the sharded einsum path owns multi-device execution —
+    its psum-over-data collective is what the mesh tests attest)."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:                                   # pragma: no cover
+        return False
+    kind = getattr(dev, "device_kind", "") or ""
+    if dev.platform != "tpu" and "tpu" not in kind.lower():
+        return False
+    for x in arrays:
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
+            return False
+    return True

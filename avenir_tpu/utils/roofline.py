@@ -16,19 +16,47 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
-# Published per-chip peaks: bf16 FLOP/s and HBM bytes/s.
-# v5e: 197 TFLOP/s bf16, 819 GB/s HBM. v4: 275 TFLOP/s, 1228 GB/s.
+# Published per-chip peaks: bf16 FLOP/s, int8 OP/s, and HBM bytes/s.
+# v5e: 197 TFLOP/s bf16 / 394 TOPS int8, 819 GB/s HBM.
 _PEAKS: Dict[str, Dict[str, float]] = {
-    "TPU v5 lite": {"bf16_flops": 197e12, "hbm_bytes": 819e9},
-    "TPU v5e": {"bf16_flops": 197e12, "hbm_bytes": 819e9},
-    "TPU v5": {"bf16_flops": 459e12, "hbm_bytes": 2765e9},       # v5p
-    "TPU v4": {"bf16_flops": 275e12, "hbm_bytes": 1228e9},
-    "TPU v6 lite": {"bf16_flops": 918e12, "hbm_bytes": 1640e9},  # v6e
+    "TPU v5 lite": {"bf16_flops": 197e12, "int8_ops": 394e12,
+                    "hbm_bytes": 819e9},
+    "TPU v5e": {"bf16_flops": 197e12, "int8_ops": 394e12,
+                "hbm_bytes": 819e9},
+    "TPU v5p": {"bf16_flops": 459e12, "int8_ops": 918e12,
+                "hbm_bytes": 2765e9},
+    "TPU v5": {"bf16_flops": 459e12, "int8_ops": 918e12,
+               "hbm_bytes": 2765e9},                             # v5p
+    "TPU v4": {"bf16_flops": 275e12, "int8_ops": 275e12,
+               "hbm_bytes": 1228e9},
+    "TPU v6 lite": {"bf16_flops": 918e12, "int8_ops": 1836e12,
+                    "hbm_bytes": 1640e9},                        # v6e
+    "TPU v6e": {"bf16_flops": 918e12, "int8_ops": 1836e12,
+                "hbm_bytes": 1640e9},
 }
 
 
+def _lookup_peaks(kind: str) -> Optional[Dict[str, float]]:
+    """Exact, then normalized-substring match: device_kind strings drift
+    across PJRT transports ("TPU v5 lite" vs "TPU v5e" vs "tpu v5 lite"),
+    and a silent miss used to drop hbm_pct from bandwidth-bound benchmark
+    lines (round-2 advisory)."""
+    if kind in _PEAKS:
+        return _PEAKS[kind]
+    norm = kind.strip().lower()
+    # longest key first so "TPU v5 lite" wins over "TPU v5"; one-directional
+    # on purpose — matching a short/absent device_kind ("tpu") against table
+    # keys would silently assign some other chip's peaks where the
+    # empirical-probe fallback (with its warning) is the correct behavior
+    for key in sorted(_PEAKS, key=len, reverse=True):
+        if key.lower() in norm:
+            return _PEAKS[key]
+    return None
+
+
 def chip_peaks(probe_fallback: bool = True) -> Dict[str, float]:
-    """{"device_kind", "bf16_flops", "hbm_bytes"} for the attached chip.
+    """{"device_kind", "bf16_flops", "int8_ops", "hbm_bytes"} for the
+    attached chip.
 
     CPU backends (tests) report measured-nothing peaks of 0 → callers skip
     MFU fields rather than print garbage."""
@@ -36,12 +64,19 @@ def chip_peaks(probe_fallback: bool = True) -> Dict[str, float]:
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", dev.platform)
-    peaks = _PEAKS.get(kind)
-    if peaks is None and dev.platform == "tpu" and probe_fallback:
-        peaks = {"bf16_flops": probe_matmul_flops(), "hbm_bytes": 0.0}
+    peaks = _lookup_peaks(kind)
+    is_tpu = dev.platform == "tpu" or "tpu" in str(kind).lower()
+    if peaks is None and is_tpu and probe_fallback:
+        import logging
+        logging.getLogger("avenir_tpu").warning(
+            "unknown TPU device_kind %r: falling back to the empirical "
+            "matmul probe (hbm_bytes unknown -> bandwidth roofline fields "
+            "will be absent)", kind)
+        peaks = {"bf16_flops": probe_matmul_flops(), "int8_ops": 0.0,
+                 "hbm_bytes": 0.0}
     if peaks is None:
-        peaks = {"bf16_flops": 0.0, "hbm_bytes": 0.0}
-    return {"device_kind": kind, **peaks}
+        peaks = {"bf16_flops": 0.0, "int8_ops": 0.0, "hbm_bytes": 0.0}
+    return {"device_kind": kind, "int8_ops": 0.0, **peaks}
 
 
 def probe_matmul_flops(dim: int = 4096, iters: int = 30) -> float:
@@ -73,10 +108,11 @@ def probe_matmul_flops(dim: int = 4096, iters: int = 30) -> float:
 
 def mfu_fields(flops: Optional[float] = None, dt: Optional[float] = None,
                bytes_moved: Optional[float] = None,
-               peaks: Optional[Dict[str, float]] = None) -> Dict[str, float]:
-    """Fields to merge into a benchmark JSON line: achieved FLOP/s + MFU
-    and/or achieved bytes/s + fraction of HBM peak, for work ``flops`` /
-    ``bytes_moved`` done in ``dt`` seconds."""
+               peaks: Optional[Dict[str, float]] = None,
+               int8_ops: Optional[float] = None) -> Dict[str, float]:
+    """Fields to merge into a benchmark JSON line: achieved FLOP/s + MFU,
+    achieved int8 OP/s + fraction of int8-MXU peak, and/or achieved
+    bytes/s + fraction of HBM peak, for work done in ``dt`` seconds."""
     out: Dict[str, float] = {}
     p = peaks or chip_peaks()
     out["device_kind"] = p["device_kind"]
@@ -84,6 +120,11 @@ def mfu_fields(flops: Optional[float] = None, dt: Optional[float] = None,
         out["achieved_tflops"] = round(flops / dt / 1e12, 2)
         if p["bf16_flops"]:
             out["mfu_pct"] = round(100.0 * flops / dt / p["bf16_flops"], 2)
+    if int8_ops and dt:
+        out["achieved_int8_tops"] = round(int8_ops / dt / 1e12, 2)
+        if p.get("int8_ops"):
+            out["int8_mxu_pct"] = round(
+                100.0 * int8_ops / dt / p["int8_ops"], 2)
     if bytes_moved and dt:
         out["achieved_gbps"] = round(bytes_moved / dt / 1e9, 2)
         if p["hbm_bytes"]:

@@ -1,0 +1,111 @@
+"""MXU co-occurrence histogram kernel (interpret mode) vs the einsum path.
+
+The kernel's compiled path needs a real TPU; these tests run it through the
+Pallas interpreter on the CPU backend and assert bit-identical int32 counts
+against the einsum form it replaces (``ops/agg.py``), across shapes, invalid
+codes/labels, and non-block-aligned row counts.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from avenir_tpu.ops import agg, pallas_hist
+
+
+def _pairs(f):
+    return np.array([(i, j) for i in range(f) for j in range(i + 1, f)],
+                    np.int32).reshape(-1, 2)
+
+
+@pytest.mark.parametrize("n,f,b,c", [
+    (1000, 4, 5, 3),
+    (257, 11, 12, 2),      # hosp_readmit shape, non-aligned N
+    (64, 2, 2, 2),
+])
+def test_nb_mi_step_matches_einsum(rng, n, f, b, c):
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    # sprinkle invalid codes and labels: both must be count-neutral in
+    # exactly the einsum path's way (code -1 drops that feature's cells,
+    # bad label drops the row)
+    codes[rng.integers(0, n, 25), rng.integers(0, f, 25)] = -1
+    labels[rng.integers(0, n, 10)] = -1
+    labels[rng.integers(0, n, 5)] = c + 3
+    pi = _pairs(f)
+    fbc_k, pair_k = pallas_hist.nb_mi_step(
+        jnp.asarray(codes), jnp.asarray(labels), pi[:, 0], pi[:, 1],
+        c, b, interpret=True)
+    fbc_e, pair_e = agg.nb_mi_pipeline_step(
+        jnp.asarray(codes), jnp.asarray(labels),
+        jnp.asarray(pi[:, 0]), jnp.asarray(pi[:, 1]), c, b)
+    np.testing.assert_array_equal(np.asarray(fbc_k), np.asarray(fbc_e))
+    np.testing.assert_array_equal(np.asarray(pair_k), np.asarray(pair_e))
+
+
+def test_cooc_counts_symmetry_and_marginals(rng):
+    n, f, b, c = 500, 3, 4, 2
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    g = np.asarray(pallas_hist.cooc_counts(
+        jnp.asarray(codes), jnp.asarray(labels), b, c, interpret=True))
+    w = f * b * c
+    # G is symmetric, pad region is zero
+    np.testing.assert_array_equal(g, g.T)
+    assert (g[w:] == 0).all() and (g[:, w:] == 0).all()
+    # cross-class blocks are zero: w = (bin*c + cls)*f + feat
+    cls_of_w = (np.arange(w) // f) % c
+    cross = cls_of_w[:, None] != cls_of_w[None, :]
+    assert (g[:w, :w][cross] == 0).all()
+    # diagonal of a feature's block row-sums to per-(bin, class) histogram
+    fc = np.asarray(agg.feature_class_counts(
+        jnp.asarray(codes), jnp.asarray(labels), c, b))
+    for feat in range(f):
+        for bb in range(b):
+            for cc in range(c):
+                wi = (bb * c + cc) * f + feat
+                assert g[wi, wi] == fc[feat, bb, cc]
+
+
+def test_fit_fast_path_matches_einsum_path(rng, monkeypatch):
+    """MutualInformation.fit's kernel fast path (forced on, interpret mode)
+    must produce the identical result object to the einsum path."""
+    import functools
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.models.mutual_info import MutualInformation
+
+    codes = rng.integers(0, 6, size=(400, 5)).astype(np.int32)
+    labels = rng.integers(0, 2, size=400).astype(np.int32)
+
+    def mk():
+        return EncodedDataset(codes=codes, cont=np.zeros((400, 0), np.float32),
+                              labels=labels, n_bins=np.full(5, 6, np.int32),
+                              class_values=["0", "1"],
+                              binned_ordinals=list(range(5)))
+
+    baseline = MutualInformation().fit(mk())
+    monkeypatch.setattr(pallas_hist, "on_tpu_single_device",
+                        lambda *a: True)
+    monkeypatch.setattr(
+        pallas_hist, "cooc_counts",
+        functools.partial(pallas_hist.cooc_counts.__wrapped__,
+                          interpret=True))
+    fast = MutualInformation().fit(mk())
+    np.testing.assert_array_equal(fast.feature_class_counts,
+                                  baseline.feature_class_counts)
+    np.testing.assert_array_equal(fast.pair_class_counts,
+                                  baseline.pair_class_counts)
+    np.testing.assert_allclose(fast.feature_class_mi,
+                               baseline.feature_class_mi, rtol=1e-6)
+
+
+def test_applicable_gate():
+    assert pallas_hist.applicable(11, 12, 2)          # hosp_readmit: 264
+    assert not pallas_hist.applicable(40, 12, 2)      # 960 > MAX_W
+    assert not pallas_hist.applicable(0, 12, 2)
+
+
+def test_block_cols_scales_with_width():
+    assert pallas_hist.default_block_cols(384) == pallas_hist._DEFAULT_BN
+    assert pallas_hist.default_block_cols(768) == pallas_hist._DEFAULT_BN // 2
+    assert pallas_hist.default_block_cols(768) % 128 == 0
