@@ -346,7 +346,7 @@ def nearest_neighbors(
     """([M, k] distances, [M, k] reference indices), ascending by distance.
 
     ``mode="exact"`` (default): on TPU backends the euclidean metric
-    dispatches to the fused Pallas search (block top-2 sweep + exact
+    dispatches to the fused Pallas search (segment key-tournament + exact
     re-rank, ~9× the XLA scan at 1M refs — BASELINE.md); everything else
     uses the compiled XLA tile scan. ``mode="approx"``: a quality floor,
     not a method — when the fused exact path applies it is BOTH faster and

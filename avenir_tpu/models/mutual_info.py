@@ -170,8 +170,7 @@ class MutualInformation:
         # The einsum loop stays for meshes (its psum is the attested
         # collective), wide tables, and CPU runs — bit-identical counts.
         from avenir_tpu.ops import pallas_hist
-        fast = (self.mesh is None and pallas_hist.applicable(f, b, c)
-                and pallas_hist.on_tpu_single_device())
+        fast = pallas_hist.use_kernel(f, b, c, mesh=self.mesh)
         for ds in chunks:
             from avenir_tpu.parallel.mesh import maybe_shard_batch
             codes, labels = maybe_shard_batch(self.mesh, ds.codes, ds.labels)

@@ -91,7 +91,7 @@ def _cooc_kernel(joint_ref, out_ref, *, f: int, jc: int, w: int, wp: int,
     # ragged tail: lanes past the true row count read garbage from the
     # out-of-bounds block — neutralize them here instead of paying a
     # full-array jnp.pad copy outside (~10 ms/chunk at 16M rows)
-    if n % bn:
+    if n % bn or n == 0:
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
         joint = jnp.where(lane < n - i * bn, joint, _INVALID)
     # tile-expand: row w of the result is joint[w mod F] (jnp.concatenate
@@ -127,6 +127,11 @@ def cooc_counts(codes: jax.Array, labels: jax.Array, num_bins: int,
     jc = num_bins * num_classes
     w = f * jc
     wp = _ru(w, 128)
+    if n == 0:
+        # empty chunk (e.g. a stream's empty final block): zero counts,
+        # matching the einsum path — the kernel's OOB block read would
+        # not even trace on a zero-row operand
+        return jnp.zeros((wp, wp), jnp.int32)
     bn = block_cols or default_block_cols(wp)
     y = labels[None, :]
     valid = (y >= 0) & (y < num_classes)
@@ -199,6 +204,46 @@ def nb_mi_step(codes: jax.Array, labels: jax.Array, ci, cj,
 def applicable(num_feat: int, num_bins: int, num_classes: int) -> bool:
     """Static shape gate: is the Xᵀ·X form profitable/compilable here?"""
     return 0 < num_feat * num_bins * num_classes <= MAX_W
+
+
+def use_kernel(num_feat: int, num_bins: int, num_classes: int,
+               mesh=None) -> bool:
+    """THE routing predicate for the NB+MI count fast path — single source
+    of truth for MutualInformation.fit, bench.py and e2e_pipeline: shape
+    applicable, no mesh (the sharded einsum's psum is the attested
+    collective), and a single TPU device attached."""
+    return (mesh is None and applicable(num_feat, num_bins, num_classes)
+            and on_tpu_single_device())
+
+
+def chunk_pipeline(num_feat: int, num_bins: int, num_classes: int, ci, cj):
+    """(step, chain_scalar, is_kernel) for the per-chunk NB+MI device step.
+
+    ``step(codes, labels)`` returns the chunk's count object (G on the
+    kernel path, (fbc, pair) on the einsum path); ``chain_scalar(out)``
+    extracts the zero int32 scalar benchmarks feed into the next chunk's
+    labels operand so one final fetch syncs the whole chain.  Keeping both
+    paths' plumbing here means bench.py and e2e_pipeline cannot drift from
+    the routing the library itself uses."""
+    if use_kernel(num_feat, num_bins, num_classes):
+        def step(codes, labels):
+            return cooc_counts(codes, labels, num_bins, num_classes)
+
+        def chain_scalar(out):
+            return (out[0, 0] * 0).astype(jnp.int32)
+
+        return step, chain_scalar, True
+
+    from avenir_tpu.ops import agg
+
+    def step(codes, labels):
+        return agg.nb_mi_pipeline_step(codes, labels, ci, cj,
+                                       num_classes, num_bins)
+
+    def chain_scalar(out):
+        return (out[0][0, 0, 0] * 0).astype(jnp.int32)
+
+    return step, chain_scalar, False
 
 
 def on_tpu_single_device(*arrays) -> bool:

@@ -16,12 +16,14 @@ one bf16 pass per tile:
   slower than this), and the ‖x‖²/‖y‖² norm terms as limb-split side
   columns. Reference pad rows bake a huge finite norm term (never ±inf: a
   zero padding lane times inf is NaN, and NaN poisons every compare).
-- A running per-row top-k' (k plus a safety margin) lives in VMEM scratch
-  across the ref-block grid axis. Each block computes its row-minima in the
-  same pass that writes d², so the skip test for blocks with no improving
-  candidate costs one tiny [TM,1] compare; only improving blocks run
-  extract-min merge rounds (a while_loop whose condition *is* the skip
-  test).
+- At scale the candidate kernel is the round-3 SEGMENT KEY-TOURNAMENT
+  sweep (see its section below): int32 packed sort keys + lane-halving
+  min/max merges, per-2048-ref-segment top-2 + truncated third-min bound.
+  The merge-loop kernel in this section remains the small-reference-set
+  path (too few segments to fill the candidate pool): a running per-row
+  top-k' lives in VMEM scratch across the ref-block grid axis, and only
+  blocks with an improving candidate run extract-min merge rounds (a
+  while_loop whose condition *is* the skip test).
 - The caller then re-ranks the k' candidates with exact f32 arithmetic and
   checks an exactness certificate (k-th exact candidate distance vs the
   kernel's k'-th value minus the limb error bound); rows that fail fall
@@ -217,9 +219,15 @@ def _pack(codes: np.ndarray, cont01: np.ndarray, num_bins: int,
 
 def prepare_refs(codes: np.ndarray, cont01: np.ndarray, num_bins: int
                  ) -> Tuple[jax.Array, int]:
-    """Packed device-resident reference operand [Npad, K] bf16."""
+    """Packed device-resident reference operand [Npad, K] bf16.
+
+    Sets larger than one tournament block round up to TB (a multiple of
+    the merge kernel's TN tile, so both kernels accept the operand); small
+    sets — which can never fill the tournament's candidate pool and always
+    route to the merge kernel — round only to TN, avoiding up-to-8× padded
+    scan work on every query batch."""
     n = codes.shape[0]
-    npad = _round_up(max(n, TN), TN)
+    npad = _round_up(n, TB) if n > TB else _round_up(max(n, TN), TN)
     return _pack(codes, cont01, num_bins, npad, True, _PADC), n
 
 
@@ -241,86 +249,141 @@ def topk_candidates(q_mat, r_mat, k: int, margin: int = MARGIN
 
 
 # ---------------------------------------------------------------------------
-# block top-2 sweep — the round-2 candidate kernel
+# segmented key-tournament sweep — the round-3 candidate kernel
 # ---------------------------------------------------------------------------
-# The merge-loop kernel above costs ~70-75 ms/call on-chip at 1M refs; a
-# bisection showed the dot + per-row min is only ~18 ms — the data-dependent
-# while_loop (scalar condition extraction per block + one full-block pass
-# per extracted candidate) is the rest. This kernel removes ALL
-# data-dependent control flow: per (query row, ref block) it emits the two
-# smallest distances with their columns plus the THIRD-smallest as a bound,
-# using only unconditional vector ops (~26 ms/call measured). Exact top-k
-# is then assembled in XLA: top-k' over the 2·nblocks candidates, exact
-# re-rank, and a certificate — true top-k ⊆ candidates unless some block
-# hides ≥3 of the true top-k, i.e. unless the k-th exact distance exceeds
-# min_b(third_min_b); measured on uniform 1M refs that is ~0.05% of rows,
-# which fall back to the exact scan.
+# Round 2's block top-2 sweep cost ~26-42 ms/call at 1M refs. A round-3
+# bisection (chained-sync, fresh process) re-attributed the cost: the dot
+# itself reaches the bare-XLA matmul bound (~11 ms) once the ref block is
+# 16K rows (the "3× Mosaic overhead" of round 2 was the 16 MB default
+# scoped-VMEM limit forcing 2K-row blocks — raising vmem_limit_bytes
+# admits the big tiles), f32 min-reductions carry a ~3× NaN-semantics
+# penalty over int32, and every equality-masked extraction pass costs a
+# materialized full-array traversal. This kernel:
+#   - packs each distance into ONE int32 sort key,
+#     (bitcast(max(d2,0)) & ~(SEG-1)) | col — positive-float bitcast is
+#     order-preserving, so min-of-key IS argmin, columns ride in the low
+#     11 bits, and all comparisons become cheap int32 min/max;
+#   - extracts each 2048-ref segment's smallest two keys plus its
+#     third-smallest as the non-candidate bound via a lane-halving
+#     TOURNAMENT of sorted (m1,m2,m3) triples — pure min/max merges, no
+#     equality masks, no data-dependent control flow;
+#   - streams refs in 16K-row blocks (8 segments per DMA) so per-grid-step
+#     overhead amortizes.
+# Measured 22.1 ms/call vs 42.1 for the round-2 structure in the identical
+# fresh-process harness (1.9×). Exactness contract is unchanged from the
+# top-2 sweep: true top-k ⊆ candidates unless a segment hides ≥3 of the
+# true top-k; key truncation only LOWERS the per-segment bound (by
+# ≤ 2⁻¹² relative), which can only add cert failures, never unsound ones.
 
-def _knn_block2_kernel(a_ref, b_ref, d1_out, d2_out, i1_out, i2_out, b3_out,
-                       *, nbp: int):
+TB = 16384             # reference rows per grid step (one DMA, 8 segments)
+SEG = 2048             # certificate granularity: top-2 + third-min bound
+# pad-lane key: the int32 bit pattern of _BIG (finite; NEVER 0x7fffffff,
+# whose truncated bitcast is NaN and would poison every downstream min)
+_PAD_KEY = int(np.float32(_BIG).view(np.int32))
+
+
+def _knn_tourney_kernel(a_ref, b_ref, k1_out, k2_out, k3_out, *, nbp: int):
     j = pl.program_id(1)
+    nseg = TB // SEG
     d2v = jax.lax.dot_general(
         a_ref[:], b_ref[:], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    col = jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 1)
-    m1 = jnp.min(d2v, axis=1)
-    c1 = jnp.min(jnp.where(d2v == m1[:, None], col, TN), axis=1)
-    d2b = jnp.where(col == c1[:, None], _BIG, d2v)
-    m2 = jnp.min(d2b, axis=1)
-    c2 = jnp.min(jnp.where(d2b == m2[:, None], col, TN), axis=1)
-    d2c = jnp.where(col == c2[:, None], _BIG, d2b)
-    m3 = jnp.min(d2c, axis=1)
-    # the output row-block stays VMEM-resident across the j axis; each
-    # block writes its lane via a masked select (dynamic lane stores are
-    # not lowerable)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (TM, nbp), 1)
-    sel = lane == j
-    d1_out[:] = jnp.where(sel, m1[:, None], d1_out[:])
-    d2_out[:] = jnp.where(sel, m2[:, None], d2_out[:])
-    i1_out[:] = jnp.where(sel, (j * TN + c1)[:, None], i1_out[:])
-    i2_out[:] = jnp.where(sel, (j * TN + c2)[:, None], i2_out[:])
-    b3_out[:] = jnp.where(sel, m3[:, None], b3_out[:])
+    lane = jax.lax.broadcasted_iota(jnp.int32, (TM, TB), 1)
+    col = lane & jnp.int32(SEG - 1)
+    # max(d2, 0): the limb-split dot can go ~eps negative for near-identical
+    # points; negative-float bitcast would invert the int ordering
+    di = jax.lax.bitcast_convert_type(jnp.maximum(d2v, 0.0), jnp.int32)
+    key = (di & jnp.int32(~(SEG - 1))) | col
+    outlane = jax.lax.broadcasted_iota(jnp.int32, (TM, nbp), 1)
+    for s in range(nseg):
+        seg = key[:, s * SEG:(s + 1) * SEG]
+        # round 1: adjacent halves -> sorted pairs
+        w = SEG // 2
+        a, b = seg[:, :w], seg[:, w:]
+        m1 = jnp.minimum(a, b)
+        m2 = jnp.maximum(a, b)
+        # round 2: two sorted pairs -> sorted triple of 4
+        w //= 2
+        a1, b1 = m1[:, :w], m1[:, w:]
+        a2, b2 = m2[:, :w], m2[:, w:]
+        hi1 = jnp.maximum(a1, b1)
+        lo2 = jnp.minimum(a2, b2)
+        m1 = jnp.minimum(a1, b1)
+        m2 = jnp.minimum(hi1, lo2)
+        m3 = jnp.maximum(lo2, hi1)
+        # sorted-triple merges down to 128 lanes
+        while w > 128:
+            w //= 2
+            a1, b1 = m1[:, :w], m1[:, w:]
+            a2, b2 = m2[:, :w], m2[:, w:]
+            a3, b3 = m3[:, :w], m3[:, w:]
+            hi1 = jnp.maximum(a1, b1)
+            lo2 = jnp.minimum(a2, b2)
+            hi2 = jnp.maximum(a2, b2)
+            m1 = jnp.minimum(a1, b1)
+            m2 = jnp.minimum(hi1, lo2)
+            m3 = jnp.minimum(jnp.minimum(jnp.maximum(hi1, lo2), hi2),
+                             jnp.minimum(a3, b3))
+        # final 128 -> 1 by masked extraction on the tiny arrays; keys are
+        # unique (distinct col bits), so each mask hits exactly one lane
+        t1 = jnp.min(m1, axis=1)
+        em = jnp.where(m1 == t1[:, None], m2, m1)
+        t2 = jnp.min(em, axis=1)
+        em2 = jnp.where(em == t2[:, None],
+                        jnp.where(m1 == t1[:, None], m3, m2), em)
+        t3 = jnp.min(em2, axis=1)
+        sel = outlane == (j * nseg + s)
+        k1_out[:] = jnp.where(sel, t1[:, None], k1_out[:])
+        k2_out[:] = jnp.where(sel, t2[:, None], k2_out[:])
+        k3_out[:] = jnp.where(sel, t3[:, None], k3_out[:])
 
 
-def _topk_block2_traced(a_mat, b_mat, k: int):
-    """Block top-2 candidate generation + XLA assembly.
+def _topk_tourney_traced(a_mat, b_mat, k: int):
+    """Segment-tournament candidate generation + XLA assembly.
 
-    Returns ([Mpad, k] approx d² ascending, [Mpad, k] ref indices,
-    [Mpad] non-candidate lower bound = min over blocks of the block's
-    third-smallest distance). Requires 2 * nblocks >= k."""
+    Returns ([Mpad, k] approx (truncated-key) d² ascending, [Mpad, k] ref
+    indices, [Mpad] non-candidate lower bound = min over segments of the
+    segment's truncated third-smallest distance).
+    Requires 2 * (n/SEG) >= k and n % TB == 0 (prepare_refs pads to TB)."""
     m, n = a_mat.shape[0], b_mat.shape[0]
-    nb = n // TN
-    nbp = _round_up(nb, 128)
+    nb = n // TB
+    nseg = n // SEG
+    nbp = _round_up(nseg, 128)
     grid = (m // TM, nb)
-    kern = functools.partial(_knn_block2_kernel, nbp=nbp)
+    kern = functools.partial(_knn_tourney_kernel, nbp=nbp)
     spec = pl.BlockSpec((TM, nbp), lambda i, j: (i, 0),
                         memory_space=pltpu.VMEM)
-    d1, d2, i1, i2, b3 = pl.pallas_call(
+    k1, k2, k3 = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
             pl.BlockSpec((TM, a_mat.shape[1]), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((TN, b_mat.shape[1]), lambda i, j: (j, 0),
+            pl.BlockSpec((TB, b_mat.shape[1]), lambda i, j: (j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[spec] * 5,
-        out_shape=[
-            jax.ShapeDtypeStruct((m, nbp), jnp.float32),
-            jax.ShapeDtypeStruct((m, nbp), jnp.float32),
-            jax.ShapeDtypeStruct((m, nbp), jnp.int32),
-            jax.ShapeDtypeStruct((m, nbp), jnp.int32),
-            jax.ShapeDtypeStruct((m, nbp), jnp.float32),
-        ],
+        out_specs=[spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((m, nbp), jnp.int32)] * 3,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(a_mat, b_mat)
-    # unwritten pad lanes (j >= nb) hold garbage: mask them out
-    pad = jnp.arange(nbp) >= nb
-    big = jnp.float32(_BIG)
-    d1 = jnp.where(pad[None, :], big, d1)
-    d2 = jnp.where(pad[None, :], big, d2)
-    b3 = jnp.where(pad[None, :], big, b3)
+    # unwritten pad lanes (seg >= nseg) hold garbage: pin to the pad key
+    pad = jnp.arange(nbp) >= nseg
+    pk_ = jnp.int32(_PAD_KEY)
+    k1 = jnp.where(pad[None, :], pk_, k1)
+    k2 = jnp.where(pad[None, :], pk_, k2)
+    k3 = jnp.where(pad[None, :], pk_, k3)
+    segmask = jnp.int32(~(SEG - 1))
+    seg_base = jnp.arange(nbp, dtype=jnp.int32) * SEG
+
+    def unpack(kk_):
+        d = jax.lax.bitcast_convert_type(kk_ & segmask, jnp.float32)
+        return d, seg_base[None, :] + (kk_ & jnp.int32(SEG - 1))
+
+    d1, i1 = unpack(k1)
+    d2, i2 = unpack(k2)
+    b3 = jax.lax.bitcast_convert_type(k3 & segmask, jnp.float32)
     cand_d = jnp.concatenate([d1, d2], axis=1)
     cand_i = jnp.concatenate([i1, i2], axis=1)
     neg, pos = jax.lax.top_k(-cand_d, k)
@@ -378,23 +441,23 @@ def _pack_queries_dev(codes: jax.Array, cont01: jax.Array, num_bins: int,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "rows", "extra_norm",
-                                             "k", "kk", "total_attrs", "eps"))
+                                             "k", "kk", "total_attrs", "eps",
+                                             "use_tourney"))
 def _search_fused(codes_q: jax.Array, cont01_q: jax.Array, r_mat: jax.Array,
                   codes_r: jax.Array, cont01_r: jax.Array, n_real: int,
                   *, num_bins: int, rows: int, extra_norm: float, k: int,
-                  kk: int, total_attrs: int, eps: float):
+                  kk: int, total_attrs: int, eps: float, use_tourney: bool):
     """One dispatch: pack queries, run the pallas kernel, exact f32 re-rank.
 
     Returns ([M, k] distances in [0,1], [M, k] ref indices, [M] certificate)
     for the first ``codes_q.shape[0]`` rows of the padded query block."""
     m = codes_q.shape[0]
     q_mat = _pack_queries_dev(codes_q, cont01_q, num_bins, rows, extra_norm)
-    nblocks = r_mat.shape[0] // TN
-    block2 = 2 * nblocks >= kk
+    block2 = use_tourney
     if block2:
-        # block top-2 sweep (~2.8× the merge-loop kernel on-chip); the
-        # non-candidate bound makes the certificate exact
-        cand_d2, cand_idx, bound3 = _topk_block2_traced(q_mat, r_mat, kk)
+        # segment key-tournament sweep (1.9× the round-2 top-2 sweep); the
+        # per-segment truncated third-min bound keeps the certificate exact
+        cand_d2, cand_idx, bound3 = _topk_tourney_traced(q_mat, r_mat, kk)
     else:
         cand_d2, cand_idx = _topk_pallas_traced(q_mat, r_mat, kk)
         bound3 = cand_d2[:, -1]       # merge kernel: kk-th kept IS the bound
@@ -474,11 +537,16 @@ def search_fused(codes_q: np.ndarray, cont01_q: np.ndarray, r_mat: jax.Array,
     kk = min(k + margin, SLOTS)
     eps = D2_EPS if fc else 0.0
     rows = _round_up(max(m, TM), TM)
+    # tournament engages only when enough REAL segments exist to fill the
+    # candidate pool — pad-dominated segments would produce a uselessly
+    # small bound and fail every certificate
+    use_tourney = (2 * -(-n_real // SEG) >= kk
+                   and r_mat.shape[0] % TB == 0)
     return _search_fused(
         jnp.asarray(codes_q), jnp.asarray(cont01_q, jnp.float32), r_mat,
         codes_r_dev, cont01_r_dev, n_real,
         num_bins=num_bins, rows=rows, extra_norm=float(f), k=k, kk=kk,
-        total_attrs=total_attrs, eps=eps)
+        total_attrs=total_attrs, eps=eps, use_tourney=use_tourney)
 
 
 def exact_rerank(cand_idx: np.ndarray, cand_d2: np.ndarray,

@@ -70,23 +70,11 @@ def main():
     dcodes = jnp.asarray(codes)
     dlabels = jnp.asarray(labels)
 
-    kernel_path = (pallas_hist.applicable(n_feat, n_bins, n_classes)
-                   and pallas_hist.on_tpu_single_device(dcodes, dlabels))
-    if kernel_path:
-        # the round-3 primary path: per-chunk G accumulation on the int8
-        # MXU; the chain below feeds a scalar of G into the next chunk's
-        # labels operand so one final fetch syncs every chunk
-        def pipeline_step(c, l):
-            return pallas_hist.cooc_counts(c, l, n_bins, n_classes)
-
-        def chain_scalar(out):
-            return (out[0, 0] * 0).astype(jnp.int32)
-    else:
-        def pipeline_step(c, l):
-            return agg.nb_mi_pipeline_step(c, l, ci, cj, n_classes, n_bins)
-
-        def chain_scalar(out):
-            return (out[0][0, 0, 0] * 0).astype(jnp.int32)
+    # single source of the kernel-vs-einsum routing (and each path's
+    # chain-scalar extractor): ops/pallas_hist.chunk_pipeline — the same
+    # predicate MutualInformation.fit and e2e_pipeline use
+    pipeline_step, chain_scalar, kernel_path = pallas_hist.chunk_pipeline(
+        n_feat, n_bins, n_classes, ci, cj)
 
     # Sync discipline: jax.block_until_ready is a NO-OP on the tunnel
     # platform (measured round 2); a host fetch of a reduced scalar is the
@@ -155,6 +143,22 @@ def main():
         int8_ops=n_chunks * chunk * int8_ops_per_row or None,
         dt=n_chunks * chunk / rows_per_sec,
         peaks=chip_peaks()))
+
+    # secondary driver metric (BASELINE.json): kNN QPS at 1M refs, embedded
+    # as a NESTED object so the one-JSON-line driver contract holds. Runs
+    # with the on-chip oracle verification; measured after the primary so
+    # the primary never inherits kNN warmup state. Free memory first: the
+    # NB+MI operands (codes+labels, ~3 GB over two copies) plus the kNN
+    # reference set must not coexist on a 16 GB chip.
+    if kernel_path:
+        del dcodes, dlabels
+        from benchmarks.knn_qps import measure as knn_measure
+        knn = knn_measure(verify=True, quick=True)
+        line["knn"] = {kf: knn[kf] for kf in
+                       ("value", "unit", "k", "batch", "n_refs",
+                        "pipelined_passes_qps", "single_shot_qps",
+                        "verified_vs_oracle", "mfu_pct")
+                       if kf in knn}
     print(json.dumps(line))
 
 

@@ -51,25 +51,11 @@ def main():
                         np.int32)
     ci, cj = pair_idx[:, 0], pair_idx[:, 1]
 
-    # same device work as bench.py's primary metric: the MXU co-occurrence
-    # kernel when the chip supports it (the per-job G read-out is host-side
-    # and amortized), einsum otherwise
+    # same device work as bench.py's primary metric, routed by the same
+    # shared predicate (the per-job G read-out is host-side and amortized)
     from avenir_tpu.ops import pallas_hist
-    kernel_path = (pallas_hist.applicable(f, nb, n_classes)
-                   and pallas_hist.on_tpu_single_device())
-    if kernel_path:
-        def device_step(codes, labels):
-            return pallas_hist.cooc_counts(codes, labels, nb, n_classes)
-
-        def chain_scalar(out):
-            return (out[0, 0] * 0).astype(jnp.int32)
-    else:
-        def device_step(codes, labels):
-            return agg.nb_mi_pipeline_step(codes, labels, ci, cj,
-                                           n_classes, nb)
-
-        def chain_scalar(out):
-            return (out[0][0, 0, 0] * 0).astype(jnp.int32)
+    device_step, chain_scalar, kernel_path = pallas_hist.chunk_pipeline(
+        f, nb, n_classes, ci, cj)
 
     # warm up compile + native path (sync = host fetch; block_until_ready
     # is a no-op on the tunnel platform — BASELINE.md timing methodology)

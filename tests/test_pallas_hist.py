@@ -109,3 +109,13 @@ def test_block_cols_scales_with_width():
     assert pallas_hist.default_block_cols(384) == pallas_hist._DEFAULT_BN
     assert pallas_hist.default_block_cols(768) == pallas_hist._DEFAULT_BN // 2
     assert pallas_hist.default_block_cols(768) % 128 == 0
+
+
+def test_cooc_counts_empty_chunk():
+    """A stream's empty final chunk must yield zero counts (the einsum
+    path's behavior), not an unmasked out-of-bounds block read."""
+    codes = np.zeros((0, 4), np.int32)
+    labels = np.zeros((0,), np.int32)
+    g = np.asarray(pallas_hist.cooc_counts(
+        jnp.asarray(codes), jnp.asarray(labels), 5, 2, interpret=True))
+    assert g.shape == (128, 128) and (g == 0).all()

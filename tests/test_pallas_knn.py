@@ -149,7 +149,10 @@ def test_search_fused_block2_path_matches_oracle(rng):
     cont_q = rng.random(size=(m, fc)).astype(np.float32)
     with pltpu.force_tpu_interpret_mode():
         r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
-        assert 2 * (r_mat.shape[0] // pk.TN) >= k + pk.MARGIN
+        # pin the TOURNAMENT path: enough real segments for the pool and a
+        # TB-aligned operand (the round-3 engagement gate in search_fused)
+        assert 2 * -(-n_real // pk.SEG) >= k + pk.MARGIN
+        assert r_mat.shape[0] % pk.TB == 0
         d, i, cert = pk.search_fused(
             codes_q, cont_q, r_mat, jnp.asarray(codes_r),
             jnp.asarray(cont_r), n_real, nb, k, f + fc)
@@ -177,7 +180,8 @@ def test_search_fused_block2_short_last_block_not_falsely_certified(rng):
     cont_q = rng.random(size=(m, fc)).astype(np.float32)
     with pltpu.force_tpu_interpret_mode():
         r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
-        assert 2 * (r_mat.shape[0] // pk.TN) >= k + pk.MARGIN  # block2 path
+        assert 2 * -(-n_real // pk.SEG) >= k + pk.MARGIN   # tournament path
+        assert r_mat.shape[0] % pk.TB == 0
         d, i, cert = pk.search_fused(
             codes_q, cont_q, r_mat, jnp.asarray(codes_r),
             jnp.asarray(cont_r), n_real, nb, k, f + fc)
@@ -206,7 +210,8 @@ def test_search_fused_block2_heavy_ties_and_duplicates(rng):
     cont_q = rng.random(size=(m, fc)).astype(np.float32)
     with pltpu.force_tpu_interpret_mode():
         r_mat, n_real = pk.prepare_refs(codes_r, cont_r, nb)
-        assert 2 * (r_mat.shape[0] // pk.TN) >= k + pk.MARGIN
+        assert 2 * -(-n_real // pk.SEG) >= k + pk.MARGIN   # tournament path
+        assert r_mat.shape[0] % pk.TB == 0
         d, i, cert = pk.search_fused(
             codes_q, cont_q, r_mat, jnp.asarray(codes_r),
             jnp.asarray(cont_r), n_real, nb, k, f + fc)
