@@ -23,6 +23,11 @@ def parse_args(argv: List[str]) -> Tuple[str, Dict[str, str], List[str]]:
     overrides: Dict[str, str] = {}
     positional: List[str] = []
     for arg in argv[1:]:
+        if arg == "--resume":
+            # sugar for -Dstream.resume=true (restore the latest
+            # stream.checkpoint.dir snapshot and continue from its cursor)
+            overrides["stream.resume"] = "true"
+            continue
         if arg.startswith("-D"):
             body = arg[2:]
             if "=" not in body:

@@ -24,7 +24,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.core.csv_io import iter_csv_chunks, read_csv
 from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
 from avenir_tpu.core.schema import FeatureSchema
@@ -201,25 +201,55 @@ class Job:
     def _sniff_ncols(path: str, delim: str, block: int = 1 << 16) -> int:
         """Field count of the first non-blank line of ``path``, reading in
         bounded blocks (never the whole file). 0 when the file has no
-        non-blank line."""
-        buf = b""
+        non-blank line.
+
+        Delimiters are counted per block as the first line streams by, so
+        a single-line multi-GB file costs O(L) work and O(block) memory —
+        the previous form accumulated the line in one buffer and re-scanned
+        it from offset 0 on every block (O(L²); round-2 advisory)."""
+        d = delim.encode()
+        # single-byte delimiters (the normal case) can never straddle a
+        # block boundary, so the count accumulates per block and the line
+        # itself is never retained; multi-byte delimiters keep the line
+        # buffered (counted once at line end) with the newline search
+        # resuming where the last block left off — O(L) either way.
+        streaming = len(d) == 1 and d != b"\r"
+        count = -1                     # -1: still skipping blank lines
+        tail = b""                     # carried bytes (1 on streaming path)
+        scan0 = 0                      # newline-search resume offset
         with open(path, "rb") as fh:
             while True:
                 chunk = fh.read(block)
-                buf += chunk
-                pos = 0
-                while True:
-                    nl = buf.find(b"\n", pos)
+                data = tail + chunk
+                tail = b""
+                at_eof = not chunk
+                while count < 0:
+                    nl = data.find(b"\n")
                     if nl < 0:
+                        if data.strip():
+                            count = 0          # first line starts here
+                        elif at_eof:
+                            return 0
                         break
-                    ln = buf[pos:nl]
-                    if ln.strip():
-                        return ln.rstrip(b"\r").count(delim.encode()) + 1
-                    pos = nl + 1
-                buf = buf[pos:]
-                if not chunk:                  # EOF: trailing partial line
-                    return (buf.rstrip(b"\r").count(delim.encode()) + 1
-                            if buf.strip() else 0)
+                    if data[:nl].strip():      # whole first line in hand
+                        return data[:nl].rstrip(b"\r").count(d) + 1
+                    data = data[nl + 1:]       # blank line: skip
+                if count < 0:
+                    tail = data
+                    continue
+                nl = data.find(b"\n", scan0)
+                if nl >= 0:
+                    return count + data[:nl].rstrip(b"\r").count(d) + 1
+                if at_eof:
+                    return count + data.rstrip(b"\r").count(d) + 1
+                if streaming:
+                    # keep one byte so a final \r\n still strips correctly
+                    body, tail = data[:-1], data[-1:]
+                    count += body.count(d)
+                    scan0 = 0
+                else:
+                    tail = data
+                    scan0 = len(data)
 
     @staticmethod
     def _encode_input_native(input_path: str, enc: DatasetEncoder,
@@ -286,7 +316,7 @@ class Job:
 
     def encoded_data_source(self, conf: JobConfig, input_path: str,
                             counters: Counters, with_labels: bool = True,
-                            mesh=None):
+                            mesh=None, checkpointer=None):
         """(encoder, data, rows_fn) for count-aggregation jobs whose model
         ``fit`` accepts either one EncodedDataset or a chunk iterable.
 
@@ -302,45 +332,84 @@ class Job:
         on device (sharded over ``mesh`` when given — the same placement the
         model's fit would apply) while the compiled step consumes chunk N —
         the I/O/compute overlap Hadoop's mapper JVMs gave the reference for
-        free."""
+        free.
+
+        With a :class:`StreamCheckpointer` the stream resumes from the
+        persisted cursor and snapshots (count totals, cursor, rows) every N
+        consumed chunks. The cursor travels WITH each chunk through the
+        prefetch queue, so a checkpoint always describes exactly the chunks
+        the model has accumulated — the feeder's read-ahead can never let
+        the cursor outrun the counts (which on crash would silently drop
+        the in-flight chunks from the resumed totals)."""
         if conf.get("stream.chunk.rows"):
             enc = self.encoder_for(conf)
-            box = {"n": 0}
+            ckpt = checkpointer
+            base_rows = ckpt.base_rows if ckpt else 0
+            box = {"n": base_rows}
 
-            def chunks():
-                for d in self.iter_encoded_retrying(
-                        conf, input_path, enc, counters,
-                        with_labels=with_labels):
-                    box["n"] += d.num_rows
-                    yield d
-
-            data = chunks()
+            pairs = self.iter_encoded_retrying(
+                conf, input_path, enc, counters, with_labels=with_labels,
+                start=ckpt.start if ckpt else None, emit_cursor=True)
             depth = conf.get_int("stream.prefetch.depth", 2)
             if depth > 0:
                 from avenir_tpu.runtime.feeder import DeviceFeeder
 
-                def stage(ds):
+                def stage(item):
                     from avenir_tpu.parallel.mesh import maybe_shard_batch
+                    ds, cur = item
                     codes, labels, cont = maybe_shard_batch(
                         mesh, ds.codes, ds.labels, ds.cont)
                     return EncodedDataset(
                         codes=codes, cont=cont, labels=labels, ids=ds.ids,
                         n_bins=ds.n_bins, class_values=ds.class_values,
                         binned_ordinals=ds.binned_ordinals,
-                        cont_ordinals=ds.cont_ordinals)
+                        cont_ordinals=ds.cont_ordinals), cur
 
-                data = DeviceFeeder(data, depth=depth, stage=stage)
-            return enc, data, lambda: box["n"]
+                pairs = DeviceFeeder(pairs, depth=depth, stage=stage)
+
+            def consume():
+                if ckpt is None:
+                    # plain streaming: straight pass-through (no lookahead —
+                    # it would pin one staged chunk beyond the prefetch
+                    # depth for no benefit)
+                    for ds, cur in pairs:
+                        box["n"] = base_rows + cur["rows"]
+                        yield ds
+                    return
+                # one-pair lookahead: a checkpoint for chunk k is written
+                # only when chunk k+1 exists, so a persisted cursor never
+                # points at end-of-stream (a resume therefore always has at
+                # least one chunk to re-read, which keeps the models'
+                # peek-first-chunk metadata contract intact)
+                it = iter(pairs)
+                prev = next(it, None)
+                while prev is not None:
+                    ds, cur = prev
+                    box["n"] = base_rows + cur["rows"]
+                    yield ds
+                    nxt = next(it, None)
+                    ckpt.chunk_done(cur, last=nxt is None)
+                    prev = nxt
+
+            return enc, consume(), lambda: box["n"]
         enc, ds, _rows = self.encode_input(conf, input_path,
                                            with_labels=with_labels,
                                            need_rows=False)
         return enc, ds, lambda: ds.num_rows
 
     @staticmethod
+    def stream_checkpointer(conf: JobConfig):
+        """The job's StreamCheckpointer, or None when not configured."""
+        return StreamCheckpointer.from_conf(conf)
+
+
+    @staticmethod
     def iter_encoded_retrying(conf: JobConfig, input_path: str,
                               encoder: DatasetEncoder,
                               counters: Counters,
-                              with_labels: bool = True) -> Iterator[EncodedDataset]:
+                              with_labels: bool = True,
+                              start: Optional[dict] = None,
+                              emit_cursor: bool = False):
         """Stream encoded chunks with per-chunk retry — the streaming train
         path, gated by ``stream.chunk.rows``.
 
@@ -352,6 +421,13 @@ class Job:
         read loop is owned here rather than delegated to
         ``iter_input_chunks`` precisely because retries need seekable
         addressing, which a generator cannot replay).
+
+        ``start`` resumes mid-stream from a cursor a previous run persisted
+        (``{"file", "offset", "chunk"}`` — the position AFTER the last
+        accumulated chunk); ``emit_cursor`` yields ``(chunk, cursor)`` pairs
+        where the cursor additionally carries the cumulative ``rows``
+        yielded since ``start`` — the checkpoint/resume seam for streaming
+        aggregation jobs (StreamCheckpointer).
 
         Requires a schema-complete encoder (vocabularies via
         ``cardinality``, numeric ranges via ``min``/``max``), exactly the
@@ -369,9 +445,18 @@ class Job:
         # python transform, so the native path also gates on completeness
         use_native = (native.is_available() and len(delim) == 1 and
                       (encoder._fitted or encoder.schema_complete(with_labels)))
-        i = 0
-        for f in input_files(input_path):
-            offset = 0
+        i = int(start["chunk"]) if start else 0
+        rows_out = 0
+        all_files = list(input_files(input_path))
+        if start:
+            if start["file"] not in all_files:
+                raise ConfigError(
+                    f"resume cursor names {start['file']!r}, which is not "
+                    f"among the input files — the input changed since the "
+                    f"checkpoint was written")
+            all_files = all_files[all_files.index(start["file"]):]
+        for fi, f in enumerate(all_files):
+            offset = int(start["offset"]) if start and fi == 0 else 0
             while True:
                 def task(path=f, off=offset):
                     with open(path, "rb") as fh:
@@ -399,4 +484,86 @@ class Job:
                 if ds is None:
                     break
                 i += 1
-                yield ds
+                if emit_cursor:
+                    rows_out += ds.num_rows
+                    yield ds, {"file": f, "offset": offset, "chunk": i,
+                               "rows": rows_out}
+                else:
+                    yield ds
+
+
+class StreamCheckpointer:
+    """Mid-stream durability for streaming count-aggregation jobs.
+
+    Hadoop gave the reference per-task durability for free: map outputs are
+    materialized, so a crashed job re-runs only failed tasks. The streaming
+    jobs here accumulate count tensors in memory across the whole input, so
+    without this a crash at chunk N restarts from zero. Configured via:
+
+    - ``stream.checkpoint.dir``: snapshot directory (enables the feature)
+    - ``stream.checkpoint.interval.chunks``: snapshot every N consumed
+      chunks (default 8)
+    - ``stream.resume``: restore the latest snapshot and continue from its
+      cursor (also the CLI's ``--resume`` flag)
+    - ``stream.fault.crash.after.chunks``: fault injection — raise after N
+      consumed chunks (kill-and-resume testing, incl. the 100M-row proof)
+
+    The snapshot is {accumulator totals, cursor(file, offset, chunk),
+    rows}; counts are integer (or order-stable float64) host totals, so a
+    resumed run's model files are byte-identical to an uninterrupted one.
+    On successful job completion :meth:`finish` removes the directory —
+    stale snapshots must never leak into a later, unrelated run."""
+
+    def __init__(self, directory: str, interval_chunks: int = 8,
+                 resume: bool = False, crash_after_chunks: int = 0):
+        from avenir_tpu.ops import agg
+        from avenir_tpu.utils.checkpoint import CheckpointManager
+
+        self.mgr = CheckpointManager(directory, keep=2)
+        self.directory = directory
+        self.interval = max(int(interval_chunks), 1)
+        self.crash_after = int(crash_after_chunks)
+        self.accumulator = agg.Accumulator()
+        self.base_rows = 0
+        self.start: Optional[dict] = None      # cursor to resume from
+        self._consumed = 0                     # chunks consumed THIS run
+        if resume:
+            state = self.mgr.restore()
+            if state is not None:
+                self.accumulator.load(state["acc"])
+                self.base_rows = int(state["rows"])
+                self.start = {k: state["cursor"][k]
+                              for k in ("file", "offset", "chunk")}
+
+    @classmethod
+    def from_conf(cls, conf: JobConfig) -> Optional["StreamCheckpointer"]:
+        directory = conf.get("stream.checkpoint.dir")
+        if not directory or not conf.get("stream.chunk.rows"):
+            return None
+        return cls(directory,
+                   conf.get_int("stream.checkpoint.interval.chunks", 8),
+                   conf.get_bool("stream.resume", False),
+                   conf.get_int("stream.fault.crash.after.chunks", 0))
+
+    def chunk_done(self, cursor: dict, last: bool) -> None:
+        """Called by the stream after the model has accumulated the chunk
+        ``cursor`` describes; snapshots on the interval (never for the
+        final chunk — the job completes and finish() deletes the state)."""
+        self._consumed += 1
+        total_rows = self.base_rows + int(cursor["rows"])
+        if not last and self._consumed % self.interval == 0:
+            self.mgr.save(int(cursor["chunk"]),
+                          {"acc": self.accumulator.state(),
+                           "cursor": {"file": cursor["file"],
+                                      "offset": int(cursor["offset"]),
+                                      "chunk": int(cursor["chunk"])},
+                           "rows": total_rows})
+        if self.crash_after and self._consumed >= self.crash_after:
+            raise RuntimeError(
+                f"stream.fault.crash.after.chunks={self.crash_after}: "
+                f"injected crash after chunk {cursor['chunk']}")
+
+    def finish(self) -> None:
+        """Remove the snapshot directory after a successful run."""
+        import shutil
+        shutil.rmtree(self.directory, ignore_errors=True)

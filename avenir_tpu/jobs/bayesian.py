@@ -29,12 +29,19 @@ class BayesianDistribution(Job):
         nbayes = nb.NaiveBayes(laplace=conf.get_float("laplace.smoothing", 1.0),
                                mesh=self.auto_mesh(conf))
         # stream.chunk.rows switches to the chunked read+encode stream under
-        # the task-retry policy (needs a schema-complete encoder)
+        # the task-retry policy (needs a schema-complete encoder);
+        # stream.checkpoint.dir additionally persists (counts, cursor) every
+        # N chunks so a killed run resumes with --resume / stream.resume
+        ckpt = self.stream_checkpointer(conf)
         enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters,
-                                                      mesh=nbayes.mesh)
-        model = nbayes.fit(data)
+                                                      mesh=nbayes.mesh,
+                                                      checkpointer=ckpt)
+        model = nbayes.fit(
+            data, accumulator=ckpt.accumulator if ckpt else None)
         lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
         write_output(output_path, lines)
+        if ckpt:
+            ckpt.finish()
         counters.set("Records", "Processed", rows_fn())
         counters.set("Model", "Rows", len(lines))
 

@@ -36,12 +36,15 @@ class MutualInformation(Job):
         delim = conf.field_delim
         schema = self.load_schema(conf)
         mesh = self.auto_mesh(conf)
+        ckpt = self.stream_checkpointer(conf)
         enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters,
-                                                      mesh=mesh)
+                                                      mesh=mesh,
+                                                      checkpointer=ckpt)
         names = [schema.field_by_ordinal(f.ordinal).name
                  for f in enc.binned_fields]
         result = mi.MutualInformation(mesh=mesh).fit(
-            data, feature_names=names)
+            data, feature_names=names,
+            accumulator=ckpt.accumulator if ckpt else None)
         lines: List[str] = []
         if conf.get_bool("output.mutual.info", True):
             lines.extend(result.to_lines(delim=delim))
@@ -55,6 +58,8 @@ class MutualInformation(Job):
             lines.extend(
                 delim.join([names[f], f"{score:.6f}"]) for f, score in ranked)
         write_output(output_path, lines)
+        if ckpt:
+            ckpt.finish()
         counters.set("Records", "Processed", rows_fn())
 
 

@@ -295,7 +295,10 @@ class HMMBuilder:
             # chunk to >= the cap.
             d = (self.mesh.shape.get("data", 1)
                  if self.mesh is not None else 1) or 1
-            step = ((agg.MAX_EXACT_CHUNK_ROWS - 1) // d) * d
+            # max(·, d) keeps the loop well-formed even for a (theoretical)
+            # data axis wider than the chunk cap, where the floored multiple
+            # would be 0 and range(0, n, 0) would raise (round-2 advisory)
+            step = max(((agg.MAX_EXACT_CHUNK_ROWS - 1) // d) * d, d)
             for s0 in range(0, len(st_all), step):
                 st_b, ob_b, w_b = maybe_shard_batch(
                     self.mesh, st_all[s0:s0 + step], ob_all[s0:s0 + step],

@@ -154,14 +154,18 @@ class MutualInformation:
         self.mesh = mesh
 
     def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]],
-            feature_names: Optional[Sequence[str]] = None) -> MutualInfoResult:
+            feature_names: Optional[Sequence[str]] = None,
+            accumulator=None) -> MutualInfoResult:
+        """``accumulator``: an externally-owned (possibly checkpoint-restored)
+        ``agg.Accumulator`` — the streaming jobs pass their
+        StreamCheckpointer's so mid-stream snapshots see the totals."""
         meta, chunks = peek_chunks(data)           # lazy: stream-friendly
         if meta.labels is None:
             raise ValueError("mutual information requires a class attribute")
         f, b, c = meta.num_binned, meta.max_bins, meta.num_classes
         pair_index = np.array([(i, j) for i in range(f) for j in range(i + 1, f)],
                               np.int32).reshape(-1, 2)
-        acc = agg.Accumulator()
+        acc = accumulator if accumulator is not None else agg.Accumulator()
         # single-TPU fast path: one MXU co-occurrence kernel per chunk
         # (ops/pallas_hist.py, ~4-5× the einsum form) accumulates the
         # [Wp, Wp] G matrix; the [F,B,C] tensor and every pair's [B,B,C]
@@ -171,6 +175,22 @@ class MutualInformation:
         # collective), wide tables, and CPU runs — bit-identical counts.
         from avenir_tpu.ops import pallas_hist
         fast = pallas_hist.use_kernel(f, b, c, mesh=self.mesh)
+        # a checkpoint-restored accumulator dictates the path: counts from a
+        # crashed run on the OTHER path must not be silently dropped. A
+        # kernel-path snapshot ("g") resumed where the kernel no longer
+        # applies converts G into the einsum path's tensors (exact); an
+        # einsum-path snapshot simply continues on the einsum path.
+        if accumulator is not None and len(pair_index):
+            if "g" in accumulator and not fast:
+                g = accumulator.state()
+                fc0, pcc0 = pallas_hist.counts_from_cooc(
+                    g.pop("g"), f, b, c, pair_index[:, 0], pair_index[:, 1])
+                g["fc"] = fc0
+                for s in range(0, len(pair_index), self.pair_chunk):
+                    g[f"pcc{s}"] = pcc0[s:s + self.pair_chunk]
+                accumulator.load(g)
+            elif "fc" in accumulator and fast:
+                fast = False
         for ds in chunks:
             from avenir_tpu.parallel.mesh import maybe_shard_batch
             codes, labels = maybe_shard_batch(self.mesh, ds.codes, ds.labels)

@@ -169,9 +169,13 @@ class NaiveBayes:
         from avenir_tpu.parallel.mesh import maybe_shard_batch
         return maybe_shard_batch(self.mesh, *arrays)
 
-    def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]]) -> NaiveBayesModel:
+    def fit(self, data: Union[EncodedDataset, Iterable[EncodedDataset]],
+            accumulator=None) -> NaiveBayesModel:
+        """``accumulator``: an externally-owned (possibly checkpoint-restored)
+        ``agg.Accumulator`` — the streaming jobs pass their
+        StreamCheckpointer's so mid-stream snapshots see the totals."""
         meta, chunks = peek_chunks(data)
-        acc = agg.Accumulator()
+        acc = accumulator if accumulator is not None else agg.Accumulator()
         for ds in chunks:
             meta = ds
             if ds.labels is None:

@@ -228,3 +228,12 @@ class Accumulator:
 
     def names(self):
         return list(self._totals)
+
+    # -- checkpointable state (streaming-job mid-stream durability) ----------
+    def state(self) -> dict:
+        """name → numpy total, a copy safe to hand to checkpoint writers."""
+        return {k: np.array(v) for k, v in self._totals.items()}
+
+    def load(self, state: dict) -> None:
+        """Replace the totals with a restored snapshot."""
+        self._totals = {k: np.asarray(v) for k, v in state.items()}

@@ -238,6 +238,7 @@ class ShardedServingFleet:
                         for _ in range(self.num_workers)]
         self._servers: List[dict] = [{} for _ in range(self.num_workers)]
         self._errors: List[BaseException] = []
+        self._closed = False
         self._threads = []
         for w in range(self.num_workers):
             t = threading.Thread(target=self._work, args=(w,), daemon=True)
@@ -269,11 +270,16 @@ class ShardedServingFleet:
 
     def dispatch(self, group: str, event_id: str, round_num: int) -> None:
         """Route one event to its group's worker (blocks on backpressure)."""
+        if self._closed:
+            # a dispatch after close() would silently enqueue to a dead
+            # worker and, once the bounded queue fills, block forever
+            raise RuntimeError("dispatch() after close()")
         self._queues[hash(group) % self.num_workers].put(
             (group, event_id, round_num))
 
     def close(self) -> None:
         """Flush queues, stop workers, re-raise the first worker error."""
+        self._closed = True
         for q in self._queues:
             q.put(None)
         for t in self._threads:
@@ -289,6 +295,181 @@ class ShardedServingFleet:
             for group, srv in servers.items():
                 out[group] = srv.checkpoint()
         return out
+
+
+# ---------------------------------------------------------------------------
+# process-backed serving — the Storm num.workers (multi-JVM) analog
+# ---------------------------------------------------------------------------
+
+class _ForwardingActionWriter:
+    """Tees a server's action writes to the parent's result queue (the
+    caller-provided transport still runs in the worker — a Redis writer's
+    effects are globally visible; an in-proc queue's are not, which is why
+    the parent needs the forwarded copy)."""
+
+    def __init__(self, inner, group: str, out_q):
+        self.inner = inner
+        self.group = group
+        self.out_q = out_q
+
+    def write(self, event_id: str, actions: List[str]) -> None:
+        self.inner.write(event_id, actions)
+        self.out_q.put(("act", self.group, event_id, list(actions)))
+
+
+def _fleet_worker(worker_id: int, server_factory, in_q, out_q) -> None:
+    servers: dict = {}
+    while True:
+        item = in_q.get()
+        if item is None:
+            out_q.put(("ckpt", worker_id,
+                       [(g, srv.checkpoint()) for g, srv in servers.items()]))
+            return
+        group, event_id, round_num = item
+        try:
+            srv = servers.get(group)
+            if srv is None:
+                srv = servers[group] = server_factory(group)
+                srv.actions = _ForwardingActionWriter(srv.actions, group,
+                                                      out_q)
+            srv.handle(event_id, round_num)
+        except BaseException as e:     # surfaced on close()
+            out_q.put(("err", worker_id, repr(e)))
+
+
+class ProcessServingFleet:
+    """Multi-PROCESS event dispatch with per-group learner state — the
+    capacity analog of Storm's ``num.workers`` (one JVM per worker,
+    ReinforcementLearnerTopology.java:42-85), where
+    :class:`ShardedServingFleet` mirrors ``num.bolt.threads`` (executors
+    inside one JVM).
+
+    Same contract as the thread fleet: ``hash(group) % num_workers`` pins
+    each group to one worker (fieldsGrouping — learners update
+    single-threaded), bounded per-worker queues apply ``max.spout.pending``
+    backpressure, ``close()`` drains and re-raises the first worker error.
+    Because workers are processes, CPU-bound learner updates scale past the
+    GIL on multi-core hosts (thread workers cannot — BASELINE.md serving
+    notes; on the 1-core dev rig both measure flat).
+
+    Process-boundary additions:
+    - action writes are forwarded to the parent (``actions()`` after
+      ``close()`` — per-group streams in dispatch order); the factory's own
+      transport still runs in the worker, so Redis-backed writers behave
+      exactly as in the thread fleet;
+    - learner state is collected at shutdown (``checkpoints()``), matching
+      the thread fleet's post-close semantics;
+    - ``server_factory`` is transferred via fork at worker start, so it may
+      be a closure; workers are started eagerly in ``__init__`` — create
+      the fleet BEFORE initializing any accelerator runtime (forking a
+      process that holds a TPU client is undefined behavior; the serving
+      learners are numpy-only by design).
+    """
+
+    def __init__(self, server_factory: Callable[[str], ReinforcementLearnerServer],
+                 num_workers: int = 2, max_pending: int = 128,
+                 mp_context: str = "fork"):
+        import multiprocessing as mp
+
+        ctx = mp.get_context(mp_context)
+        self.num_workers = max(num_workers, 1)
+        self._in_qs = [ctx.Queue(maxsize=max(max_pending, 1))
+                       for _ in range(self.num_workers)]
+        self._out_q = ctx.Queue()
+        self._procs = [
+            ctx.Process(target=_fleet_worker,
+                        args=(w, server_factory, self._in_qs[w], self._out_q),
+                        daemon=True)
+            for w in range(self.num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        self._closed = False
+        self._actions: List[Tuple[str, str, List[str]]] = []
+        self._checkpoints: dict = {}
+        self._errors: List[str] = []
+        self.dispatched = 0
+
+    def dispatch(self, group: str, event_id: str, round_num: int) -> None:
+        """Route one event to its group's worker (blocks on backpressure)."""
+        import queue as _qmod
+
+        if self._closed:
+            raise RuntimeError("dispatch() after close()")
+        w = hash(group) % self.num_workers
+        while True:
+            try:
+                self._in_qs[w].put((group, event_id, round_num), timeout=1.0)
+                break
+            except _qmod.Full:
+                # backpressure against a DEAD worker would block forever
+                if not self._procs[w].is_alive():
+                    raise RuntimeError(
+                        f"serving worker {w} died (exitcode "
+                        f"{self._procs[w].exitcode}); queue full")
+        self.dispatched += 1
+
+    def _drain_out(self, expect_ckpts: int) -> None:
+        import queue as _qmod
+
+        remaining = expect_ckpts
+        while remaining:
+            try:
+                kind, *rest = self._out_q.get(timeout=1.0)
+            except _qmod.Empty:
+                # a worker killed without sending its ckpt (OOM, segfault in
+                # native code) must not hang close() on a get() that can
+                # never be satisfied
+                dead = sum(1 for p in self._procs if not p.is_alive())
+                if dead >= remaining and self._out_q.empty():
+                    self._errors.append(
+                        f"{dead} serving worker(s) died without shutdown "
+                        f"handshake (exitcodes "
+                        f"{[p.exitcode for p in self._procs]})")
+                    return
+                continue
+            if kind == "act":
+                group, event_id, actions = rest
+                self._actions.append((group, event_id, actions))
+            elif kind == "err":
+                self._errors.append(rest[1])
+            elif kind == "ckpt":
+                for group, blob in rest[1]:
+                    self._checkpoints[group] = blob
+                remaining -= 1
+
+    def close(self) -> None:
+        """Flush queues, stop workers, re-raise the first worker error."""
+        import queue as _qmod
+
+        if self._closed:
+            return
+        self._closed = True
+        for w, q in enumerate(self._in_qs):
+            while True:
+                try:
+                    q.put(None, timeout=1.0)
+                    break
+                except _qmod.Full:
+                    if not self._procs[w].is_alive():
+                        break          # dead worker: nothing to hand-shake
+        self._drain_out(expect_ckpts=self.num_workers)
+        for p in self._procs:
+            p.join(timeout=30.0)
+            if p.is_alive():           # wedged worker: don't hang close()
+                p.terminate()
+        if self._errors:
+            raise RuntimeError(f"serving worker failed: {self._errors[0]}")
+
+    def actions(self) -> List[Tuple[str, str, List[str]]]:
+        """(group, event_id, actions) in per-group dispatch order (call
+        after close())."""
+        return list(self._actions)
+
+    def checkpoints(self) -> dict:
+        """group → learner-state JSON collected at worker shutdown (call
+        after close())."""
+        return dict(self._checkpoints)
 
 
 # ---------------------------------------------------------------------------

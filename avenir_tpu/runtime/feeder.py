@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import weakref
 from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
@@ -94,9 +95,23 @@ class DeviceFeeder:
         self._drain()
         # a put blocked past its stop check can still land one item after
         # the first drain; once the worker has exited nothing else can be
-        # enqueued, so join-then-drain makes the drop reliable
+        # enqueued, so join-then-drain makes the drop reliable. If the
+        # worker outlives the timeout (e.g. stage() wedged in a device
+        # transfer), keep drain-polling — bounded at 60 s so a truly hung
+        # transport cannot wedge close() — then give up loudly: the worker
+        # is a daemon thread, so at worst one staged buffer stays pinned
+        # until process exit.
         self._thread.join(timeout=10.0)
         self._drain()
+        deadline = time.monotonic() + 60.0
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            self._thread.join(timeout=1.0)
+            self._drain()
+        if self._thread.is_alive():
+            import logging
+            logging.getLogger("avenir_tpu").warning(
+                "DeviceFeeder worker still alive 60s after close(); "
+                "up to one staged buffer may stay pinned until exit")
 
     def _drain(self) -> None:
         try:

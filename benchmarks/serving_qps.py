@@ -51,6 +51,22 @@ def fleet_events_per_sec(num_workers: int, n_groups: int = 32,
     return n_events / dt
 
 
+def process_fleet_events_per_sec(num_workers: int, n_groups: int = 32,
+                                 n_events: int = 40_000) -> float:
+    # The num.workers (multi-process) pool: on a multi-core host this is
+    # the knob that scales CPU-bound learners past the GIL; on the 1-core
+    # dev rig it measures the IPC overhead honestly.
+    fleet = st.ProcessServingFleet(make_server, num_workers=num_workers,
+                                   max_pending=256)
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        fleet.dispatch(f"g{i % n_groups}", f"ev{i}", i)
+    fleet.close()
+    dt = time.perf_counter() - t0
+    assert len(fleet.actions()) == n_events
+    return n_events / dt
+
+
 def single_event_latencies(n: int = 20_000):
     srv = make_server("g")
     events = srv.events.queue
@@ -71,12 +87,15 @@ def single_event_latencies(n: int = 20_000):
 
 def main():
     rates = {w: round(fleet_events_per_sec(w), 1) for w in (1, 2, 4)}
+    proc_rates = {w: round(process_fleet_events_per_sec(w), 1)
+                  for w in (1, 2, 4)}
     lats = single_event_latencies()
     print(json.dumps({
         "metric": "serving_events_per_sec",
         "value": max(rates.values()),
         "unit": "events/sec",
         "events_per_sec_by_workers": rates,
+        "process_events_per_sec_by_workers": proc_rates,
         "p50_latency_us": round(float(np.percentile(lats, 50)) * 1e6, 1),
         "p99_latency_us": round(float(np.percentile(lats, 99)) * 1e6, 1),
         "groups": 32,

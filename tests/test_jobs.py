@@ -569,7 +569,10 @@ def test_streaming_prefetch_feeder_engages_and_matches(churn_env, monkeypatch):
 
     def spying_next(self):
         item = orig_next(self)
-        staged_types.append(type(item.codes))
+        # streaming jobs feed (chunk, cursor) pairs through the feeder (the
+        # checkpoint seam); the chunk is the first element
+        ds = item[0] if isinstance(item, tuple) else item
+        staged_types.append(type(ds.codes))
         return item
 
     monkeypatch.setattr(DeviceFeeder, "__next__", spying_next)

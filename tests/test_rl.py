@@ -266,3 +266,85 @@ def test_sharded_serving_fleet_error_surfaces():
     fleet.dispatch("g", "ev1", 1)
     with pytest.raises(RuntimeError, match="factory boom"):
         fleet.close()
+
+
+def test_process_fleet_matches_thread_fleet_action_streams():
+    """Storm num.workers parity: the process-backed fleet must produce the
+    IDENTICAL per-group action stream (and learner end-state) as the thread
+    fleet for the same deterministic event sequence."""
+    groups = ["gA", "gB", "gC", "gD"]
+    actions = ["p1", "p2", "p3"]
+    n_rounds = 60
+
+    def factory(group):
+        learner = orl.create_learner(
+            "intervalEstimator", actions,
+            {"min.reward.distr.sample": 10}, seed=11)
+        srv = st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(st.InProcQueue()),
+            st.QueueRewardReader(st.InProcQueue()),
+            st.QueueActionWriter(st.InProcQueue()))
+        return srv
+
+    # thread fleet: capture per-group action streams via the servers
+    thread_actions = {g: [] for g in groups}
+    captured = {}
+
+    def thread_factory(group):
+        srv = factory(group)
+        inner = srv.actions
+
+        class Tee:
+            def write(self, event_id, acts):
+                inner.write(event_id, acts)
+                thread_actions[group].append((event_id, list(acts)))
+
+        srv.actions = Tee()
+        captured[group] = srv
+        return srv
+
+    tf = st.ShardedServingFleet(thread_factory, num_workers=2, max_pending=16)
+    for i in range(1, n_rounds + 1):
+        for g in groups:
+            tf.dispatch(g, f"ev{g}{i}", i)
+    tf.close()
+    thread_ckpts = tf.checkpoints()
+
+    pf = st.ProcessServingFleet(factory, num_workers=2, max_pending=16)
+    for i in range(1, n_rounds + 1):
+        for g in groups:
+            pf.dispatch(g, f"ev{g}{i}", i)
+    pf.close()
+    proc_actions = {g: [] for g in groups}
+    for g, event_id, acts in pf.actions():
+        proc_actions[g].append((event_id, acts))
+    assert proc_actions == thread_actions
+    assert pf.checkpoints() == thread_ckpts
+
+
+def test_process_fleet_error_surfaces_and_post_close_dispatch():
+    def factory(group):
+        raise RuntimeError("factory boom")
+
+    fleet = st.ProcessServingFleet(factory, num_workers=1)
+    fleet.dispatch("g", "ev1", 1)
+    with pytest.raises(RuntimeError, match="factory boom"):
+        fleet.close()
+    with pytest.raises(RuntimeError, match="after close"):
+        fleet.dispatch("g", "ev2", 2)
+
+
+def test_thread_fleet_dispatch_after_close_raises():
+    def factory(group):
+        learner = orl.create_learner("intervalEstimator", ["a", "b"],
+                                     {"min.reward.distr.sample": 5}, seed=1)
+        return st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(st.InProcQueue()),
+            st.QueueRewardReader(st.InProcQueue()),
+            st.QueueActionWriter(st.InProcQueue()))
+
+    fleet = st.ShardedServingFleet(factory, num_workers=1)
+    fleet.dispatch("g", "ev1", 1)
+    fleet.close()
+    with pytest.raises(RuntimeError, match="after close"):
+        fleet.dispatch("g", "ev2", 2)

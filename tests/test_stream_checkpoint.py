@@ -1,0 +1,171 @@
+"""Streaming-job checkpoint/resume: kill-and-resume must be byte-identical.
+
+The reference got mid-job durability from Hadoop's task model (map outputs
+are materialized; a crashed job re-runs failed tasks, not the world).  The
+rebuild's streaming jobs accumulate count tensors in memory, so
+StreamCheckpointer (jobs/base.py) persists (totals, cursor, rows) every N
+consumed chunks; these tests kill a run mid-stream with the fault-injection
+property and assert the resumed run's model files match an uninterrupted
+run byte for byte.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.datagen.hosp_readmit import HOSP_SCHEMA_JSON, generate_hosp_readmit
+from avenir_tpu.jobs import get_job
+from avenir_tpu.jobs.base import Job, StreamCheckpointer
+
+
+N_ROWS = 3000
+CHUNK = 250          # 12 chunks
+
+
+@pytest.fixture()
+def workload(tmp_path):
+    rows = generate_hosp_readmit(N_ROWS, seed=5)
+    csv = tmp_path / "train.csv"
+    csv.write_text("\n".join(",".join(r) for r in rows) + "\n")
+    schema = tmp_path / "schema.json"
+    schema.write_text(json.dumps(HOSP_SCHEMA_JSON) if isinstance(
+        HOSP_SCHEMA_JSON, dict) else HOSP_SCHEMA_JSON)
+
+    def conf(**extra):
+        c = JobConfig()
+        c.set("feature.schema.file.path", str(schema))
+        c.set("stream.chunk.rows", str(CHUNK))
+        c.set("data.parallel.auto", "false")
+        for k, v in extra.items():
+            c.set(k.replace("_", "."), str(v))
+        return c
+
+    return csv, conf
+
+
+def _part(path):
+    with open(os.path.join(path, "part-00000"), "rb") as fh:
+        return fh.read()
+
+
+@pytest.mark.parametrize("job_name", ["BayesianDistribution",
+                                      "MutualInformation"])
+def test_kill_and_resume_byte_identical(tmp_path, workload, job_name):
+    csv, conf = workload
+    clean_out = tmp_path / "clean"
+    get_job(job_name).run(conf(), str(csv), str(clean_out))
+
+    ckdir = tmp_path / "ckpt"
+    crashed_out = tmp_path / "crashed"
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_job(job_name).run(
+            conf(stream_checkpoint_dir=ckdir,
+                 stream_checkpoint_interval_chunks=3,
+                 stream_fault_crash_after_chunks=7),
+            str(csv), str(crashed_out))
+    assert not os.path.exists(os.path.join(crashed_out, "part-00000"))
+    assert os.listdir(ckdir)           # a snapshot survived the crash
+
+    resumed_out = tmp_path / "resumed"
+    c = get_job(job_name).run(
+        conf(stream_checkpoint_dir=ckdir,
+             stream_checkpoint_interval_chunks=3,
+             stream_resume="true"),
+        str(csv), str(resumed_out))
+    assert _part(resumed_out) == _part(clean_out)
+    # Records Processed counts the WHOLE input, not just the resumed tail
+    assert c.get("Records", "Processed") == N_ROWS
+    # successful completion cleared the snapshot dir
+    assert not os.path.exists(ckdir)
+
+
+def test_resume_without_checkpoint_is_fresh_run(tmp_path, workload):
+    csv, conf = workload
+    clean_out = tmp_path / "clean"
+    get_job("BayesianDistribution").run(conf(), str(csv), str(clean_out))
+    out = tmp_path / "fresh_resume"
+    get_job("BayesianDistribution").run(
+        conf(stream_checkpoint_dir=tmp_path / "nope", stream_resume="true"),
+        str(csv), str(out))
+    assert _part(out) == _part(clean_out)
+
+
+def test_cursor_resume_skips_consumed_chunks(tmp_path, workload):
+    """iter_encoded_retrying(start=...) must continue exactly after the
+    cursor: re-reading from a mid-stream cursor yields the remaining rows
+    only, in order."""
+    from avenir_tpu.utils.metrics import Counters
+
+    csv, conf = workload
+    c = conf()
+    enc = Job.encoder_for(c)
+    counters = Counters()
+    pairs = list(Job.iter_encoded_retrying(c, str(csv), enc, counters,
+                                           emit_cursor=True))
+    assert len(pairs) == N_ROWS // CHUNK
+    cut = 5
+    rest = list(Job.iter_encoded_retrying(
+        c, str(csv), enc, counters,
+        start={k: pairs[cut - 1][1][k] for k in ("file", "offset", "chunk")},
+        emit_cursor=True))
+    assert len(rest) == len(pairs) - cut
+    np.testing.assert_array_equal(rest[0][0].codes, pairs[cut][0].codes)
+    assert rest[0][1]["chunk"] == pairs[cut][1]["chunk"]
+    # cumulative rows restart from the cursor (the checkpointer adds its
+    # restored base)
+    assert rest[-1][1]["rows"] == (len(pairs) - cut) * CHUNK
+
+
+def test_checkpointer_interval_and_crash(tmp_path):
+    ck = StreamCheckpointer(str(tmp_path / "ck"), interval_chunks=2,
+                            crash_after_chunks=5)
+    ck.accumulator.add("x", np.arange(3))
+    cursors = [{"file": "f", "offset": 10 * (i + 1), "chunk": i + 1,
+                "rows": 7 * (i + 1)} for i in range(5)]
+    for cur in cursors[:4]:
+        ck.chunk_done(cur, last=False)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        ck.chunk_done(cursors[4], last=False)
+    ck2 = StreamCheckpointer(str(tmp_path / "ck"), interval_chunks=2,
+                             resume=True)
+    assert ck2.start == {"file": "f", "offset": 40, "chunk": 4}
+    assert ck2.base_rows == 28
+    np.testing.assert_array_equal(ck2.accumulator.get("x"), np.arange(3))
+
+
+def test_mi_resume_across_path_flip_converts_counts(tmp_path, workload,
+                                                    monkeypatch):
+    """A kernel-path ("g") snapshot resumed where the kernel no longer
+    applies must convert G into the einsum tensors, not drop the pre-crash
+    counts (round-3 review finding)."""
+    import functools
+    from avenir_tpu.ops import pallas_hist
+
+    csv, conf = workload
+    clean_out = tmp_path / "clean"
+    get_job("MutualInformation").run(conf(), str(csv), str(clean_out))
+
+    # crash a run forced onto the (interpret-mode) kernel path
+    monkeypatch.setattr(pallas_hist, "on_tpu_single_device", lambda *a: True)
+    monkeypatch.setattr(
+        pallas_hist, "cooc_counts",
+        functools.partial(pallas_hist.cooc_counts.__wrapped__,
+                          interpret=True))
+    ckdir = tmp_path / "ck_flip"
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_job("MutualInformation").run(
+            conf(stream_checkpoint_dir=ckdir,
+                 stream_checkpoint_interval_chunks=2,
+                 stream_fault_crash_after_chunks=5),
+            str(csv), str(tmp_path / "crashed_flip"))
+    monkeypatch.undo()
+
+    # resume on the einsum path (CPU backend: kernel gate is off again)
+    out = tmp_path / "resumed_flip"
+    get_job("MutualInformation").run(
+        conf(stream_checkpoint_dir=ckdir, stream_resume="true"),
+        str(csv), str(out))
+    assert _part(out) == _part(clean_out)
