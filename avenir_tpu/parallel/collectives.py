@@ -236,3 +236,29 @@ def sharded_mi_step(mesh: Mesh, num_classes: int, num_bins: int,
     wrapped = _shard_map_norep(step, mesh, in_specs,
                                (P(model_axis, None, None, None), P(), P()))
     return jax.jit(wrapped)
+
+
+def sharded_cooc_step(mesh: Mesh, num_bins: int, num_classes: int,
+                      interpret: bool = False):
+    """Data-sharded MXU co-occurrence count step (the round-3 count kernel
+    under explicit SPMD): each device runs the Pallas XᵀX kernel
+    (ops/pallas_hist.py) over its local rows — the per-device partial is
+    the reference's combiner — and ONE ``psum`` over ``data`` plays the
+    shuffle. G's j-major layout is identical to the single-device kernel,
+    so ``pallas_hist.counts_from_cooc`` reads the result out unchanged.
+
+    ``interpret=True`` runs the kernel through the Pallas interpreter —
+    how the CPU-mesh dryrun/tests attest the collective wiring without
+    Mosaic hardware; on a TPU mesh leave it False."""
+    from avenir_tpu.ops import pallas_hist
+
+    def step(codes, labels):
+        g = pallas_hist.cooc_counts.__wrapped__(
+            codes, labels, num_bins, num_classes, interpret=interpret)
+        return jax.lax.psum(g, "data")
+
+    # norep: pallas_call outputs don't carry varying-mesh-axis metadata, so
+    # the replication check cannot validate them
+    wrapped = _shard_map_norep(step, mesh,
+                               (P("data", None), P("data")), P())
+    return jax.jit(wrapped)
