@@ -564,6 +564,8 @@ class StreamCheckpointer:
                 f"injected crash after chunk {cursor['chunk']}")
 
     def finish(self) -> None:
-        """Remove the snapshot directory after a successful run."""
-        import shutil
-        shutil.rmtree(self.directory, ignore_errors=True)
+        """Remove this run's snapshots after a successful run.  Deletes only
+        manager-owned ``step_*``/temp entries — never unrelated files a user
+        may keep in the same (possibly shared) directory — and the directory
+        itself only once it is empty."""
+        self.mgr.clear()

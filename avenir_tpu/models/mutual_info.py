@@ -180,7 +180,7 @@ class MutualInformation:
         # kernel-path snapshot ("g") resumed where the kernel no longer
         # applies converts G into the einsum path's tensors (exact); an
         # einsum-path snapshot simply continues on the einsum path.
-        if accumulator is not None and len(pair_index):
+        if accumulator is not None:
             if "g" in accumulator and not fast:
                 g = accumulator.state()
                 fc0, pcc0 = pallas_hist.counts_from_cooc(

@@ -409,10 +409,13 @@ class ProcessServingFleet:
                         f"{self._procs[w].exitcode}); queue full")
         self.dispatched += 1
 
-    def _drain_out(self, expect_ckpts: int) -> None:
+    def _drain_out(self, expect_ckpts: int, deadline: float = 60.0) -> None:
         import queue as _qmod
+        import time as _time
 
         remaining = expect_ckpts
+        t_end = _time.monotonic() + deadline
+        empty_after_dead = 0
         while remaining:
             try:
                 kind, *rest = self._out_q.get(timeout=1.0)
@@ -421,13 +424,32 @@ class ProcessServingFleet:
                 # native code) must not hang close() on a get() that can
                 # never be satisfied
                 dead = sum(1 for p in self._procs if not p.is_alive())
-                if dead >= remaining and self._out_q.empty():
-                    self._errors.append(
-                        f"{dead} serving worker(s) died without shutdown "
-                        f"handshake (exitcodes "
-                        f"{[p.exitcode for p in self._procs]})")
-                    return
+                if dead >= remaining:
+                    # a just-exited worker's queue feeder thread may still be
+                    # flushing its ckpt/act payload into the pipe, so require
+                    # several consecutive empty polls before declaring the
+                    # handshake lost (each get() above already waited 1 s)
+                    empty_after_dead += 1
+                    if empty_after_dead >= 3:
+                        self._errors.append(
+                            f"{dead} serving worker(s) died without shutdown "
+                            f"handshake (exitcodes "
+                            f"{[p.exitcode for p in self._procs]})")
+                        return
+                else:
+                    # live-but-wedged worker (hung in handle()): bound the
+                    # IDLE time so close() terminates it instead of hanging —
+                    # t_end resets on every received message, so a fleet
+                    # draining a deep backlog slowly but steadily never trips
+                    if _time.monotonic() > t_end:
+                        self._errors.append(
+                            f"{remaining} serving worker(s) idle without "
+                            f"shutdown handshake for {deadline:.0f}s "
+                            f"(wedged in handle()?); terminating")
+                        return
                 continue
+            empty_after_dead = 0
+            t_end = _time.monotonic() + deadline
             if kind == "act":
                 group, event_id, actions = rest
                 self._actions.append((group, event_id, actions))
@@ -445,7 +467,9 @@ class ProcessServingFleet:
         if self._closed:
             return
         self._closed = True
+        import time as _time
         for w, q in enumerate(self._in_qs):
+            t_end = _time.monotonic() + 30.0     # per-worker budget
             while True:
                 try:
                     q.put(None, timeout=1.0)
@@ -453,6 +477,14 @@ class ProcessServingFleet:
                 except _qmod.Full:
                     if not self._procs[w].is_alive():
                         break          # dead worker: nothing to hand-shake
+                    if _time.monotonic() > t_end:
+                        # wedged worker holding a full queue: give up on the
+                        # sentinel, let the drain deadline + terminate below
+                        # reclaim it (DeviceFeeder.close bounds the same way)
+                        self._errors.append(
+                            f"serving worker {w} input queue still full at "
+                            f"close deadline; skipping shutdown sentinel")
+                        break
         self._drain_out(expect_ckpts=self.num_workers)
         for p in self._procs:
             p.join(timeout=30.0)

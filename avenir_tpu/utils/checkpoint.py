@@ -188,3 +188,24 @@ class CheckpointManager:
             if step is None:
                 return None
         return load_state(os.path.join(self.directory, f"step_{step}"))
+
+    def clear(self) -> None:
+        """Remove every manager-owned entry (``step_N`` snapshots, their
+        ``.bak`` twins, ``.ckpt_*`` temps), then the directory itself —
+        but ONLY if nothing else lives there.  Users may point the
+        checkpoint dir at a shared area holding unrelated files; a
+        successful run must never delete those."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for name in names:
+            owned = (name.startswith(".ckpt_") or _STEP_RE.match(name)
+                     or (name.endswith(".bak") and _STEP_RE.match(name[:-4])))
+            if owned:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+        try:
+            os.rmdir(self.directory)        # only succeeds when empty
+        except OSError:
+            pass

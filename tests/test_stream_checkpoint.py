@@ -82,6 +82,26 @@ def test_kill_and_resume_byte_identical(tmp_path, workload, job_name):
     assert not os.path.exists(ckdir)
 
 
+def test_finish_preserves_unrelated_files(tmp_path, workload):
+    """stream.checkpoint.dir may point at a shared directory holding
+    unrelated files; a successful run must delete only its own step_*
+    snapshots, never the user's files (round-3 advisor finding)."""
+    csv, conf = workload
+    ckdir = tmp_path / "shared"
+    ckdir.mkdir()
+    (ckdir / "precious.txt").write_text("keep me")
+    (ckdir / "other_dir").mkdir()
+    (ckdir / "other_dir" / "data.bin").write_bytes(b"\x00\x01")
+    get_job("BayesianDistribution").run(
+        conf(stream_checkpoint_dir=ckdir,
+             stream_checkpoint_interval_chunks=2),
+        str(csv), str(tmp_path / "out"))
+    assert (ckdir / "precious.txt").read_text() == "keep me"
+    assert (ckdir / "other_dir" / "data.bin").exists()
+    # ...but the snapshots themselves are gone
+    assert not [n for n in os.listdir(ckdir) if n.startswith("step_")]
+
+
 def test_resume_without_checkpoint_is_fresh_run(tmp_path, workload):
     csv, conf = workload
     clean_out = tmp_path / "clean"
