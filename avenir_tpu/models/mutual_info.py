@@ -175,16 +175,27 @@ class MutualInformation:
         # collective), wide tables, and CPU runs — bit-identical counts.
         from avenir_tpu.ops import pallas_hist
         fast = pallas_hist.use_kernel(f, b, c, mesh=self.mesh)
+        gk = pallas_hist.g_key(f, b, c)
         # a checkpoint-restored accumulator dictates the path: counts from a
         # crashed run on the OTHER path must not be silently dropped. A
-        # kernel-path snapshot ("g") resumed where the kernel no longer
-        # applies converts G into the einsum path's tensors (exact); an
-        # einsum-path snapshot simply continues on the einsum path.
+        # kernel-path snapshot (layout-qualified G key) resumed where the
+        # kernel no longer applies converts G into the einsum path's tensors
+        # (exact); an einsum-path snapshot simply continues on the einsum
+        # path.  A G key from a DIFFERENT kernel layout/version (e.g. the
+        # round-3 j-major "g") cannot be read with this build's indexing —
+        # reject it loudly rather than corrupt counts.
         if accumulator is not None:
-            if "g" in accumulator and not fast:
+            stale = [k for k in accumulator.names()
+                     if (k == "g" or k.startswith("g:")) and k != gk]
+            if stale:
+                raise ValueError(
+                    f"checkpoint holds count matrix {stale[0]!r} from an "
+                    f"incompatible kernel layout (this build uses {gk!r}); "
+                    f"restart the job without --resume")
+            if gk in accumulator and not fast:
                 g = accumulator.state()
                 fc0, pcc0 = pallas_hist.counts_from_cooc(
-                    g.pop("g"), f, b, c, pair_index[:, 0], pair_index[:, 1])
+                    g.pop(gk), f, b, c, pair_index[:, 0], pair_index[:, 1])
                 g["fc"] = fc0
                 for s in range(0, len(pair_index), self.pair_chunk):
                     g[f"pcc{s}"] = pcc0[s:s + self.pair_chunk]
@@ -196,7 +207,7 @@ class MutualInformation:
             codes, labels = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             acc.add("class", agg.class_counts(labels, c))
             if fast:
-                acc.add("g", pallas_hist.cooc_counts(codes, labels, b, c))
+                acc.add(gk, pallas_hist.cooc_counts(codes, labels, b, c))
                 continue
             acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
             for s in range(0, len(pair_index), self.pair_chunk):
@@ -204,9 +215,9 @@ class MutualInformation:
                 pcc = agg.pair_class_counts(
                     codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b)
                 acc.add(f"pcc{s}", pcc)
-        if "g" in acc:
+        if gk in acc:
             fc_full, pcc_full = pallas_hist.counts_from_cooc(
-                acc.get("g"), f, b, c, pair_index[:, 0], pair_index[:, 1])
+                acc.get(gk), f, b, c, pair_index[:, 0], pair_index[:, 1])
         elif len(pair_index):
             fc_full = acc.get("fc")
             pcc_full = np.concatenate(

@@ -9,31 +9,50 @@ hardware peak; BASELINE.md round-2 perf notes).  This kernel replaces the
 scatter lowering entirely:
 
     every NB/MI count table is a sub-block of  G = Xᵀ X,
-    where X is the [N, W] one-hot of the joint (feature, bin, class) code,
-    W = F·B·C.
+    where X is the [N, W] one-hot of the joint (feature, bin, class) code.
 
-X is never materialized in HBM (round 2 measured the dense-matmul-with-
-HBM-one-hot form traffic-bound and slower than scatter).  Instead the
-kernel streams the [F, N] int32 joint-code array through VMEM in column
-blocks, expands each block to Xᵀ in registers/VMEM (tile-concatenate +
-compare — no gather), and feeds the int8 MXU path, accumulating G in an
-int32 [Wp, Wp] VMEM block across the grid:
+X is never materialized in HBM.  The round-4 kernel is FULLY FUSED and
+COLUMNAR: it streams the [F, N] int32 code array and the [1, N] labels
+through VMEM in column blocks, computes the joint code, expands the block
+to Xᵀ int8 in VMEM, and accumulates G = XᵀX on the int8 MXU path in int32.
+Nothing but the raw codes ever crosses HBM — no XLA transpose, no joint
+materialization (round 4 measured the round-3 prologue at ~11 ms of the
+~50 ms 16M-row chunk; benchmarks/cooc_expand_sweep.py).
 
-    joint  [F, BN]  --tile x JC-->  [W, BN]  ==iota//F==>  Xᵀ int8
-    G += Xᵀ·X      (int8 MXU pass, int32 accumulate — exact)
+Two expansion layouts, routed statically by :func:`plan`:
 
-Layout: G's row/col index is j-major, ``w = (bin·C + class)·F + feature``
-— the native order of a tile-style repeat (result row w = input row
-w mod F).  :func:`nb_mi_step` re-indexes G into the reference-shaped
-[F, B, C] and [P, B, B, C] tensors.
+- ``fmaj`` (primary): a 3-D broadcast compare
+  ``(joint[:, None, :] == iota_jc32)`` producing int8 directly — jc is
+  padded to 32 so the int8 (32, 128) tiling is clean and the reshape to
+  [F·jc32, BN] is a no-op tile collapse.  Row w = f·jc32 + (bin·C + cls).
+  Used whenever the jc padding does not inflate the padded gram width.
+- ``jmaj`` (fallback for shapes where it would): the round-3 tile-
+  concatenate + iota//F compare; row w = (bin·C + cls)·F + f.
 
-Measured round 3 (TPU v5 lite, chained-dispatch host-fetch sync,
-16M-row chunks, hosp_readmit shape F=11 B=12 C=2, Wp=384):
-~480-500 M rows/s vs ~80-113 M for the einsum/scatter form — the kernel
-is int8-MXU-bound (the Xᵀ·X pass alone is ~12.6 ms of the ~34 ms/chunk;
-the rest is the VPU expand/compare at W·N cells), not HBM-bound: the
-[F, N] int32 joint stream it reads is 44 B/row ≈ 18 GB/s at this rate,
-so the roofline resource is MXU occupancy, not bandwidth.
+Round-4 bisection (TPU v5 lite, fresh process per variant, chained-
+dispatch host-fetch sync, 16M-row chunks, hosp_readmit shape F=11 B=12
+C=2, Wp=384 — benchmarks/cooc_expand_sweep.py, dot_orient_probe.py,
+xla_gram_probe.py):
+
+- round-3 shipped kernel (XLA transpose + joint prologue + j-major
+  in-VMEM expand) vs the fused columnar fmaj kernel, measured
+  BACK-TO-BACK in one session: 319M → **381M rows/s median
+  (+19%)**, insensitive to block_cols 49k→98k.  Absolute rates on this
+  rig drift ±20% on ~30-minute scales (the identical fused config
+  re-measured 333M half an hour later; r3's driver artifact captured
+  366M for the old kernel) — only same-session A/B deltas are
+  comparable, and BENCH_r04.json records whatever the driver's session
+  captures;
+- zero-expand floor (dot + streaming only): 37.8 ms/chunk — i.e. the
+  expand costs ~4 ms (~10%), NOT the ~60% round 3 estimated;
+- the governing wall is the W=384 int8 gram itself: ~115-125 effective
+  TOPS (~30% of the 394 int8 peak) in BOTH Mosaic and bare XLA (bare-XLA
+  dot on a pre-materialized HBM one-hot: 43.5 ms per 16M rows — slower
+  than this whole kernel).  bf16 (83 int8-equiv TOPS), int4 (emulated,
+  21 TOPS), batched-gram and distinct-operand forms all measure worse;
+  XLA's gram efficiency rises with W (255 TOPS at W=1152), so the
+  small-output gram is the documented compiler/hardware ceiling at this
+  schema width.
 
 Exactness: int8 operands are 0/1, int32 accumulation — per-chunk counts
 are exact up to 2^31 rows (the einsum path's f32 accumulation capped
@@ -47,6 +66,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -57,56 +78,114 @@ from jax.experimental.pallas import tpu as pltpu
 _INVALID = -(1 << 20)
 _PAD_SEL = -(1 << 20) - 1
 
-# The Xᵀ·X pass costs ~2·Wp² int8-MXU FLOP per row; past Wp≈768 the kernel
+# The XᵀX pass costs ~2·Wp² int8-MXU FLOP per row; past Wp≈768 the kernel
 # loses to the scatter einsum (and VMEM for the [Wp, BN] expansion runs
 # out), so the dispatcher falls back above this.
 MAX_W = 768
 
-# column-block default: ~500 M rows/s optimum on v5e for Wp=384 (sweep in
-# round-3 notes); scaled down by the wrapper for wider tables
-_DEFAULT_BN = 49152
+# column-block default for the fmaj (int8-only-VMEM) expand; the jmaj
+# fallback materializes an int32 [Wp, BN] block and scales down harder
+_DEFAULT_BN = 98304
 
 
 def _ru(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def default_block_cols(wp: int) -> int:
-    """Column block sized so the [wp, BN] int32 expansion + int8 one-hot
-    stay inside the ~110 MB VMEM budget the kernel compiles against."""
-    bn = _DEFAULT_BN * 384 // max(wp, 128)
+def plan(num_feat: int, num_bins: int, num_classes: int):
+    """Static layout plan → (mode, jcp, wp).
+
+    ``fmaj``: w = f·jcp + (bin·C + cls), jcp = jc rounded up to 32 (clean
+    int8 tiling for the broadcast expand).  Chosen unless that padding
+    would widen the padded gram (wp) versus the j-major packing — the dot
+    is ~90% of kernel time, so layout must never inflate it.
+    """
+    jc = num_bins * num_classes
+    jcp32 = _ru(jc, 32)
+    wp32 = _ru(num_feat * jcp32, 128)
+    wpj = _ru(num_feat * jc, 128)
+    if wp32 <= wpj:
+        return "fmaj", jcp32, wp32
+    return "jmaj", jc, wpj
+
+
+def g_key(num_feat: int, num_bins: int, num_classes: int) -> str:
+    """Accumulator/checkpoint key for a G matrix of this shape's layout.
+    Layout-qualified so a snapshot written under a DIFFERENT kernel layout
+    (e.g. the round-3 j-major key ``"g"``) can never be silently summed
+    with this layout's counts — resume code must detect and reject it."""
+    mode, jcp, _ = plan(num_feat, num_bins, num_classes)
+    return f"g:{mode}:{jcp}"
+
+
+def w_index(num_feat: int, num_bins: int, num_classes: int) -> np.ndarray:
+    """[F, B, C] int64 array of each cell's row/col index in G (layout per
+    :func:`plan`) — the single source of truth for G readout and tests."""
+    mode, jcp, _ = plan(num_feat, num_bins, num_classes)
+    j = np.arange(num_bins)[:, None] * num_classes + np.arange(num_classes)
+    if mode == "fmaj":
+        return (np.arange(num_feat)[:, None, None] * jcp + j[None]).astype(
+            np.int64)
+    return (j[None] * num_feat
+            + np.arange(num_feat)[:, None, None]).astype(np.int64)
+
+
+def default_block_cols(wp: int, mode: str = "fmaj") -> int:
+    """Column block sized so the expansion stays inside the ~110 MB VMEM
+    budget the kernel compiles against.  fmaj materializes only the int8
+    [wp, BN] one-hot; jmaj also holds an int32 [wp, BN] block."""
+    if mode == "fmaj":
+        bn = min(_DEFAULT_BN, (72 * 1024 * 1024) // max(wp, 128))
+    else:
+        bn = 49152 * 384 // max(wp, 128)
     return max(128, (bn // 128) * 128)
 
 
-def _cooc_kernel(joint_ref, out_ref, *, f: int, jc: int, w: int, wp: int,
-                 n: int):
+def _cooc_kernel(codes_ref, labels_ref, out_ref, *, f: int, jc: int,
+                 jcp: int, wp: int, n: int, nclass: int, mode: str):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    joint = joint_ref[:]                               # [F, BN] int32
-    bn = joint.shape[1]
+    ct = codes_ref[:]                                  # [F, BN] int32
+    y = labels_ref[:]                                  # [1, BN] int32
+    bn = ct.shape[1]
+    valid = (y >= 0) & (y < nclass)
     # ragged tail: lanes past the true row count read garbage from the
     # out-of-bounds block — neutralize them here instead of paying a
     # full-array jnp.pad copy outside (~10 ms/chunk at 16M rows)
     if n % bn or n == 0:
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
-        joint = jnp.where(lane < n - i * bn, joint, _INVALID)
-    # tile-expand: row w of the result is joint[w mod F] (jnp.concatenate
-    # measures identical to pltpu.repeat on-chip and also lowers in
-    # interpreter mode for the CPU test suite)
-    jrept = jnp.concatenate([joint] * jc, axis=0)      # [W, BN]
-    if wp > w:
-        jrept = jnp.concatenate(
-            [jrept, jnp.full((wp - w, bn), _INVALID, jnp.int32)], axis=0)
-    jw = jax.lax.broadcasted_iota(jnp.int32, (wp, 1), 0)
-    jsel = jnp.where(jw < w, jw // f, _PAD_SEL)
-    # int8 one-hot straight from the int32 compare: int8 compare/select is
-    # not lowerable (Mosaic), int32→int8 select is — and feeds the int8
-    # MXU pass at 2× the bf16 rate
-    xt = (jrept == jsel).astype(jnp.int8)              # [Wp, BN] = Xᵀ block
+        valid &= lane < n - i * bn
+    joint = jnp.where(valid, ct * nclass + y, _INVALID)
+    # out-of-range codes (≥ B) must drop out, not land on fmaj pad cells
+    # (jc ≤ iota < jcp): one [F, BN] clamp keeps G's outside-the-index-set
+    # cells exactly zero in both modes
+    joint = jnp.where(joint < jc, joint, _INVALID)
+    if mode == "fmaj":
+        # broadcast compare straight to int8 — no int32 [W, BN] copy; the
+        # [F, jc32, BN] → [F·jc32, BN] reshape is a no-op tile collapse
+        # because jc32 is a whole number of int8 sublane tiles
+        jv = jax.lax.broadcasted_iota(jnp.int32, (1, jcp, 1), 1)
+        xt = (joint[:, None, :] == jv).astype(jnp.int8)
+        xt = xt.reshape(f * jcp, bn)
+        if wp > f * jcp:
+            xt = jnp.concatenate(
+                [xt, jnp.zeros((wp - f * jcp, bn), jnp.int8)], axis=0)
+    else:
+        # j-major tile-expand: row w of the result is joint[w mod F]
+        w = f * jc
+        jrept = jnp.concatenate([joint] * jc, axis=0)  # [W, BN]
+        if wp > w:
+            jrept = jnp.concatenate(
+                [jrept, jnp.full((wp - w, bn), _INVALID, jnp.int32)], axis=0)
+        jw = jax.lax.broadcasted_iota(jnp.int32, (wp, 1), 0)
+        jsel = jnp.where(jw < w, jw // f, _PAD_SEL)
+        # int8 one-hot straight from the int32 compare: int8 compare/select
+        # is not lowerable (Mosaic), int32→int8 select is
+        xt = (jrept == jsel).astype(jnp.int8)          # [Wp, BN] = Xᵀ block
     acc = jax.lax.dot_general(xt, xt, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.int32)
     out_ref[:] += acc
@@ -114,34 +193,36 @@ def _cooc_kernel(joint_ref, out_ref, *, f: int, jc: int, w: int, wp: int,
 
 @functools.partial(jax.jit, static_argnames=(
     "num_bins", "num_classes", "block_cols", "interpret"))
-def cooc_counts(codes: jax.Array, labels: jax.Array, num_bins: int,
-                num_classes: int, *, block_cols: int | None = None,
-                interpret: bool = False) -> jax.Array:
-    """codes [N, F] int, labels [N] int → G [Wp, Wp] int32 co-occurrence
-    counts in j-major layout (``w = (bin·C + class)·F + feature``).
+def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
+                     num_classes: int, *, block_cols: int | None = None,
+                     interpret: bool = False) -> jax.Array:
+    """codes_t [F, N] int (columnar), labels [N] int → G [Wp, Wp] int32
+    co-occurrence counts (row/col index per :func:`w_index`).
 
     G[w1, w2] = #rows whose feature f1 falls in (b1, c) and f2 in (b2, c)
     — all NB/MI count tables at once.  Cross-class blocks are zero by
-    construction (a row has one label)."""
-    n, f = codes.shape
-    jc = num_bins * num_classes
-    w = f * jc
-    wp = _ru(w, 128)
+    construction (a row has one label).  This is the primary entry: it
+    streams the codes exactly as stored, with no transpose and no joint
+    materialization anywhere (fused into the kernel)."""
+    f, n = codes_t.shape
+    mode, jcp, wp = plan(f, num_bins, num_classes)
     if n == 0:
         # empty chunk (e.g. a stream's empty final block): zero counts,
         # matching the einsum path — the kernel's OOB block read would
         # not even trace on a zero-row operand
         return jnp.zeros((wp, wp), jnp.int32)
-    bn = block_cols or default_block_cols(wp)
-    y = labels[None, :]
-    valid = (y >= 0) & (y < num_classes)
-    joint = jnp.where(valid, codes.T.astype(jnp.int32) * num_classes + y,
-                      _INVALID)                        # [F, N]
+    jc = num_bins * num_classes
+    bn = block_cols or default_block_cols(wp, mode)
+    ct = codes_t.astype(jnp.int32)
+    y2 = labels.reshape(1, n).astype(jnp.int32)
     npad = _ru(max(n, bn), bn)
     return pl.pallas_call(
-        functools.partial(_cooc_kernel, f=f, jc=jc, w=w, wp=wp, n=n),
+        functools.partial(_cooc_kernel, f=f, jc=jc, jcp=jcp, wp=wp, n=n,
+                          nclass=num_classes, mode=mode),
         grid=(npad // bn,),
         in_specs=[pl.BlockSpec((f, bn), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, bn), lambda i: (0, i),
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((wp, wp), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
@@ -150,7 +231,21 @@ def cooc_counts(codes: jax.Array, labels: jax.Array, num_bins: int,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
-    )(joint)
+    )(ct, y2)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_bins", "num_classes", "block_cols", "interpret"))
+def cooc_counts(codes: jax.Array, labels: jax.Array, num_bins: int,
+                num_classes: int, *, block_cols: int | None = None,
+                interpret: bool = False) -> jax.Array:
+    """Row-major convenience wrapper: codes [N, F] → one XLA transpose
+    (HBM-bound, ~11 ms per 16M rows on the dev rig) then the fused
+    columnar kernel.  Callers that hold columnar codes should use
+    :func:`cooc_counts_cols` and skip the transpose entirely."""
+    return cooc_counts_cols.__wrapped__(
+        codes.T, labels, num_bins, num_classes, block_cols=block_cols,
+        interpret=interpret)
 
 
 def counts_from_cooc(g, num_feat: int, num_bins: int, num_classes: int,
@@ -164,26 +259,17 @@ def counts_from_cooc(g, num_feat: int, num_bins: int, num_classes: int,
     to scalar loops or pathological small batched GEMMs), i.e. slower
     than the count kernel itself, so the device hands back G and the host
     does the indexing."""
-    import numpy as np
     g = np.asarray(g)
-    f, b, c = num_feat, num_bins, num_classes
-    w = f * b * c
+    b, c = num_bins, num_classes
+    wf = w_index(num_feat, b, c)                             # [F, B, C]
+    fbc = g[wf, wf]
     ci = np.asarray(ci, np.int64)
     cj = np.asarray(cj, np.int64)
-    # w = (bin·C + class)·F + feature  (j-major kernel layout)
-    a_ = np.arange(b)[None, :, None]
-    c_ = np.arange(c)[None, None, :]
-    wf = (a_ * c + c_) * f + np.arange(f)[:, None, None]     # [F, B, C]
-    fbc = g[wf, wf]
-    grid_a = (np.arange(b)[None, :, None, None] * c
-              + np.arange(c)[None, None, None, :]) * f       # [1, B, 1, C]
-    grid_b = (np.arange(b)[None, None, :, None] * c
-              + np.arange(c)[None, None, None, :]) * f       # [1, 1, B, C]
-    idx1 = grid_a + ci[:, None, None, None]                  # [P, B, 1, C]
-    idx2 = grid_b + cj[:, None, None, None]                  # [P, 1, B, C]
     p = len(ci)
-    pair = g[np.broadcast_to(idx1, (p, b, b, c)),
-             np.broadcast_to(idx2, (p, b, b, c))]
+    wi = wf[ci][:, :, None, :]                               # [P, B, 1, C]
+    wj = wf[cj][:, None, :, :]                               # [P, 1, B, C]
+    pair = g[np.broadcast_to(wi, (p, b, b, c)),
+             np.broadcast_to(wj, (p, b, b, c))]
     return fbc, pair
 
 
@@ -203,7 +289,9 @@ def nb_mi_step(codes: jax.Array, labels: jax.Array, ci, cj,
 
 def applicable(num_feat: int, num_bins: int, num_classes: int) -> bool:
     """Static shape gate: is the Xᵀ·X form profitable/compilable here?"""
-    return 0 < num_feat * num_bins * num_classes <= MAX_W
+    if num_feat * num_bins * num_classes <= 0:
+        return False
+    return plan(num_feat, num_bins, num_classes)[2] <= MAX_W
 
 
 def use_kernel(num_feat: int, num_bins: int, num_classes: int,
@@ -216,7 +304,8 @@ def use_kernel(num_feat: int, num_bins: int, num_classes: int,
             and on_tpu_single_device())
 
 
-def chunk_pipeline(num_feat: int, num_bins: int, num_classes: int, ci, cj):
+def chunk_pipeline(num_feat: int, num_bins: int, num_classes: int, ci, cj,
+                   columnar: bool = False):
     """(step, chain_scalar, is_kernel) for the per-chunk NB+MI device step.
 
     ``step(codes, labels)`` returns the chunk's count object (G on the
@@ -224,10 +313,14 @@ def chunk_pipeline(num_feat: int, num_bins: int, num_classes: int, ci, cj):
     extracts the zero int32 scalar benchmarks feed into the next chunk's
     labels operand so one final fetch syncs the whole chain.  Keeping both
     paths' plumbing here means bench.py and e2e_pipeline cannot drift from
-    the routing the library itself uses."""
+    the routing the library itself uses.  With ``columnar=True`` (kernel
+    path only) ``step`` takes codes in [F, N] layout and skips the
+    transpose."""
     if use_kernel(num_feat, num_bins, num_classes):
+        kernel = cooc_counts_cols if columnar else cooc_counts
+
         def step(codes, labels):
-            return cooc_counts(codes, labels, num_bins, num_classes)
+            return kernel(codes, labels, num_bins, num_classes)
 
         def chain_scalar(out):
             return (out[0, 0] * 0).astype(jnp.int32)

@@ -244,8 +244,9 @@ def sharded_cooc_step(mesh: Mesh, num_bins: int, num_classes: int,
     under explicit SPMD): each device runs the Pallas XᵀX kernel
     (ops/pallas_hist.py) over its local rows — the per-device partial is
     the reference's combiner — and ONE ``psum`` over ``data`` plays the
-    shuffle. G's j-major layout is identical to the single-device kernel,
-    so ``pallas_hist.counts_from_cooc`` reads the result out unchanged.
+    shuffle. G's layout (``pallas_hist.plan``/``w_index`` — fmaj for most
+    shapes, jmaj fallback) is identical to the single-device kernel, so
+    ``pallas_hist.counts_from_cooc`` reads the result out unchanged.
 
     ``interpret=True`` runs the kernel through the Pallas interpreter —
     how the CPU-mesh dryrun/tests attest the collective wiring without

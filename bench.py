@@ -12,13 +12,16 @@ same counts (the stand-in for the reference's per-record JVM mapper loop,
 measured on a subsample and scaled), since the reference publishes no numbers
 (BASELINE.md).
 
-Round 3: the per-chunk device step is the MXU co-occurrence kernel
-(ops/pallas_hist.py — G = XᵀX over the joint (feature, bin, class) one-hot,
-int8 MXU pass) when the attached device supports it; the [F,B,C] and
-[P,B,B,C] tensors are read out of G once per job on host (microseconds —
-reported as ``finalize_ms``), exactly how MutualInformation.fit consumes it.
-The einsum/scatter form it replaced measured ~80-113 M rows/s on the same
-rig and remains the fallback (and the multi-device path).
+Round 4: the per-chunk device step is the FUSED COLUMNAR MXU co-occurrence
+kernel (ops/pallas_hist.py — G = XᵀX over the joint (feature, bin, class)
+one-hot, int8 MXU pass, joint+expand fused in-kernel, no transpose/prologue)
+when the attached device supports it; the [F,B,C] and [P,B,B,C] tensors are
+read out of G once per job on host (microseconds — reported as
+``finalize_ms``), exactly how MutualInformation.fit consumes it.  The
+einsum/scatter form it replaced measured ~80-113 M rows/s on the same rig
+and remains the fallback (and the multi-device path).  The remaining wall
+is the W=384 int8 gram's ~30%-of-peak MXU ceiling, cross-validated against
+bare XLA (see ops/pallas_hist.py docstring + benchmarks/*_probe.py).
 """
 
 import json
@@ -67,14 +70,21 @@ def main():
     pair_idx = np.array([(i, j) for i in range(n_feat) for j in range(i + 1, n_feat)], np.int32)
     ci, cj = pair_idx[:, 0], pair_idx[:, 1]
 
-    dcodes = jnp.asarray(codes)
-    dlabels = jnp.asarray(labels)
-
     # single source of the kernel-vs-einsum routing (and each path's
     # chain-scalar extractor): ops/pallas_hist.chunk_pipeline — the same
-    # predicate MutualInformation.fit and e2e_pipeline use
+    # predicate MutualInformation.fit and e2e_pipeline use.  The kernel
+    # path takes COLUMNAR [F, N] codes (round 4: the fused kernel streams
+    # codes with no device transpose — the r3 per-chunk transpose+joint
+    # prologue measured ~11 ms of the ~50 ms chunk); the one-time host
+    # transpose below is setup, not steady-state work, exactly like the
+    # one-time host→device upload.
     pipeline_step, chain_scalar, kernel_path = pallas_hist.chunk_pipeline(
-        n_feat, n_bins, n_classes, ci, cj)
+        n_feat, n_bins, n_classes, ci, cj, columnar=True)
+    if kernel_path:
+        dcodes = jnp.asarray(np.ascontiguousarray(codes.T))
+    else:
+        dcodes = jnp.asarray(codes)
+    dlabels = jnp.asarray(labels)
 
     # Sync discipline: jax.block_until_ready is a NO-OP on the tunnel
     # platform (measured round 2); a host fetch of a reduced scalar is the
@@ -128,7 +138,7 @@ def main():
     # GB/s at these rates, so both resources are reported
     from avenir_tpu.utils.roofline import chip_peaks, mfu_fields
     bytes_per_row = 4 * (n_feat + 1)
-    wp = -(-(n_feat * n_bins * n_classes) // 128) * 128
+    wp = pallas_hist.plan(n_feat, n_bins, n_classes)[2]
     int8_ops_per_row = 2 * wp * wp if kernel_path else 0
     line = {
         "metric": "nb_mi_pipeline_throughput",

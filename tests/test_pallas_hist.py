@@ -22,6 +22,7 @@ def _pairs(f):
     (1000, 4, 5, 3),
     (257, 11, 12, 2),      # hosp_readmit shape, non-aligned N
     (64, 2, 2, 2),
+    (300, 5, 6, 2),        # routes to the jmaj fallback layout
 ])
 def test_nb_mi_step_matches_einsum(rng, n, f, b, c):
     codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
@@ -43,28 +44,46 @@ def test_nb_mi_step_matches_einsum(rng, n, f, b, c):
     np.testing.assert_array_equal(np.asarray(pair_k), np.asarray(pair_e))
 
 
-def test_cooc_counts_symmetry_and_marginals(rng):
-    n, f, b, c = 500, 3, 4, 2
+@pytest.mark.parametrize("f,b,c", [
+    (3, 4, 2),             # fmaj layout
+    (5, 6, 2),             # jmaj layout
+])
+def test_cooc_counts_symmetry_and_marginals(rng, f, b, c):
+    n = 500
     codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
     labels = rng.integers(0, c, size=n).astype(np.int32)
     g = np.asarray(pallas_hist.cooc_counts(
         jnp.asarray(codes), jnp.asarray(labels), b, c, interpret=True))
-    w = f * b * c
-    # G is symmetric, pad region is zero
+    wf = pallas_hist.w_index(f, b, c)                  # [F, B, C]
+    wp = g.shape[0]
+    # G is symmetric; every cell outside the used index set is zero
     np.testing.assert_array_equal(g, g.T)
-    assert (g[w:] == 0).all() and (g[:, w:] == 0).all()
-    # cross-class blocks are zero: w = (bin*c + cls)*f + feat
-    cls_of_w = (np.arange(w) // f) % c
-    cross = cls_of_w[:, None] != cls_of_w[None, :]
-    assert (g[:w, :w][cross] == 0).all()
+    used = np.zeros(wp, bool)
+    used[wf.ravel()] = True
+    assert (g[~used] == 0).all() and (g[:, ~used] == 0).all()
+    # cross-class blocks are zero
+    cls_of_w = np.full(wp, -1)
+    for cc in range(c):
+        cls_of_w[wf[:, :, cc].ravel()] = cc
+    cross = (cls_of_w[:, None] != cls_of_w[None, :]) & used[:, None] \
+        & used[None, :]
+    assert (g[cross] == 0).all()
     # diagonal of a feature's block row-sums to per-(bin, class) histogram
     fc = np.asarray(agg.feature_class_counts(
         jnp.asarray(codes), jnp.asarray(labels), c, b))
-    for feat in range(f):
-        for bb in range(b):
-            for cc in range(c):
-                wi = (bb * c + cc) * f + feat
-                assert g[wi, wi] == fc[feat, bb, cc]
+    np.testing.assert_array_equal(g[wf, wf], fc)
+
+
+def test_columnar_entry_matches_row_major(rng):
+    n, f, b, c = 700, 4, 5, 3
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    g_rows = np.asarray(pallas_hist.cooc_counts(
+        jnp.asarray(codes), jnp.asarray(labels), b, c, interpret=True))
+    g_cols = np.asarray(pallas_hist.cooc_counts_cols(
+        jnp.asarray(np.ascontiguousarray(codes.T)), jnp.asarray(labels),
+        b, c, interpret=True))
+    np.testing.assert_array_equal(g_rows, g_cols)
 
 
 def test_fit_fast_path_matches_einsum_path(rng, monkeypatch):
@@ -106,9 +125,15 @@ def test_applicable_gate():
 
 
 def test_block_cols_scales_with_width():
-    assert pallas_hist.default_block_cols(384) == pallas_hist._DEFAULT_BN
-    assert pallas_hist.default_block_cols(768) == pallas_hist._DEFAULT_BN // 2
-    assert pallas_hist.default_block_cols(768) % 128 == 0
+    # fmaj holds only the int8 one-hot; capped at the sweep's plateau
+    assert pallas_hist.default_block_cols(384, "fmaj") == \
+        pallas_hist._DEFAULT_BN
+    # jmaj also materializes the int32 expansion and scales down harder
+    assert pallas_hist.default_block_cols(768, "jmaj") == \
+        pallas_hist.default_block_cols(384, "jmaj") // 2
+    for wp in (128, 384, 768):
+        for mode in ("fmaj", "jmaj"):
+            assert pallas_hist.default_block_cols(wp, mode) % 128 == 0
 
 
 def test_cooc_counts_empty_chunk():

@@ -156,6 +156,24 @@ def test_checkpointer_interval_and_crash(tmp_path):
     np.testing.assert_array_equal(ck2.accumulator.get("x"), np.arange(3))
 
 
+def test_mi_resume_rejects_incompatible_g_layout():
+    """A snapshot holding a G matrix under a different kernel layout key
+    (e.g. the round-3 un-qualified "g") must be rejected loudly, never
+    silently summed with this build's layout (round-4 review finding)."""
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.models.mutual_info import MutualInformation
+    from avenir_tpu.ops import agg
+
+    acc = agg.Accumulator()
+    acc.load({"g": np.zeros((384, 384), np.int64), "class": np.zeros(2)})
+    ds = EncodedDataset(
+        codes=np.zeros((10, 3), np.int32), cont=np.zeros((10, 0), np.float32),
+        labels=np.zeros(10, np.int32), n_bins=np.full(3, 4, np.int32),
+        class_values=["a", "b"], binned_ordinals=[0, 1, 2])
+    with pytest.raises(ValueError, match="incompatible kernel layout"):
+        MutualInformation().fit(ds, accumulator=acc)
+
+
 def test_mi_resume_across_path_flip_converts_counts(tmp_path, workload,
                                                     monkeypatch):
     """A kernel-path ("g") snapshot resumed where the kernel no longer
