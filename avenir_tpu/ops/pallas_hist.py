@@ -19,7 +19,7 @@ Nothing but the raw codes ever crosses HBM — no XLA transpose, no joint
 materialization (round 4 measured the round-3 prologue at ~11 ms of the
 ~50 ms 16M-row chunk; benchmarks/cooc_expand_sweep.py).
 
-Two expansion layouts, routed statically by :func:`plan`:
+Three expansion layouts, routed statically by :func:`plan`:
 
 - ``fmaj`` (primary): a 3-D broadcast compare
   ``(joint[:, None, :] == iota_jc32)`` producing int8 directly — jc is
@@ -28,6 +28,14 @@ Two expansion layouts, routed statically by :func:`plan`:
   Used whenever the jc padding does not inflate the padded gram width.
 - ``jmaj`` (fallback for shapes where it would): the round-3 tile-
   concatenate + iota//F compare; row w = (bin·C + cls)·F + f.
+- ``cls`` (wide schemas, F·B·C beyond MAX_W): G [C, Wcp, Wcp] as C
+  per-class grams over w = bin·F + f — the cross-class blocks of the
+  joint gram are zero by construction, so the split cuts the dot work
+  C× where 2-D blocking of the joint gram would merely repartition the
+  same W² work.  This closes the round-3 wide-schema gap (the reference
+  handles any cardinality via lazily-sparse reducer maps,
+  ``explore/MutualInformation.java:421-432``; here wide shapes
+  previously fell silently to the 80-113M rows/s scatter einsum).
 
 Round-4 bisection (TPU v5 lite, fresh process per variant, chained-
 dispatch host-fetch sync, 16M-row chunks, hosp_readmit shape F=11 B=12
@@ -78,10 +86,20 @@ from jax.experimental.pallas import tpu as pltpu
 _INVALID = -(1 << 20)
 _PAD_SEL = -(1 << 20) - 1
 
-# The XᵀX pass costs ~2·Wp² int8-MXU FLOP per row; past Wp≈768 the kernel
-# loses to the scatter einsum (and VMEM for the [Wp, BN] expansion runs
-# out), so the dispatcher falls back above this.
+# The XᵀX pass costs ~2·Wp² int8-MXU FLOP per row; past Wp≈768 the joint
+# gram loses ground, so wider shapes switch to the per-class mode below
+# (and past its gates, to the scatter einsum).
 MAX_W = 768
+
+# Per-class mode ("cls", round 4): cross-class blocks of G are zero by
+# construction, so C grams of width Wc = F·B cost 2·C·Wc² = 2·W²/C per
+# row — a C× FLOP cut that no 2-D blocking of the joint gram can match
+# (blocking repartitions the same W² work).  Routed for shapes the joint
+# gram can't take; per-class width and class count are gated so the
+# [C, Wcp, Wcp] accumulator and the expansion block stay in VMEM.
+MAX_W_CLS = 1536
+MAX_C_CLS = 8
+MAX_G_BYTES_CLS = 25 * 1024 * 1024
 
 # column-block default for the fmaj (int8-only-VMEM) expand; the jmaj
 # fallback materializes an int32 [Wp, BN] block and scales down harder
@@ -99,29 +117,46 @@ def plan(num_feat: int, num_bins: int, num_classes: int):
     int8 tiling for the broadcast expand).  Chosen unless that padding
     would widen the padded gram (wp) versus the j-major packing — the dot
     is ~90% of kernel time, so layout must never inflate it.
+
+    ``cls`` (wide shapes): G is [C, wp, wp] with per-class row index
+    w = bin·F + f (j-major within the class) — the per-class gram split
+    that cuts the dot work C× versus the joint gram.
     """
     jc = num_bins * num_classes
     jcp32 = _ru(jc, 32)
     wp32 = _ru(num_feat * jcp32, 128)
     wpj = _ru(num_feat * jc, 128)
-    if wp32 <= wpj:
-        return "fmaj", jcp32, wp32
-    return "jmaj", jc, wpj
+    narrow = ("fmaj", jcp32, wp32) if wp32 <= wpj else ("jmaj", jc, wpj)
+    if narrow[2] <= MAX_W:
+        return narrow
+    wcp = _ru(num_feat * num_bins, 128)
+    if (wcp <= MAX_W_CLS and 2 <= num_classes <= MAX_C_CLS
+            and num_classes * wcp * wcp * 4 <= MAX_G_BYTES_CLS):
+        return "cls", num_bins, wcp
+    return narrow          # too wide for any kernel; applicable() rejects
 
 
 def g_key(num_feat: int, num_bins: int, num_classes: int) -> str:
     """Accumulator/checkpoint key for a G matrix of this shape's layout.
     Layout-qualified so a snapshot written under a DIFFERENT kernel layout
     (e.g. the round-3 j-major key ``"g"``) can never be silently summed
-    with this layout's counts — resume code must detect and reject it."""
+    with this layout's counts — resume code must detect and reject it.
+    num_feat is part of the key because every mode's row index depends on
+    F while the padded G shape may not (two F values can share wp)."""
     mode, jcp, _ = plan(num_feat, num_bins, num_classes)
-    return f"g:{mode}:{jcp}"
+    return f"g:{mode}:{jcp}:f{num_feat}"
 
 
 def w_index(num_feat: int, num_bins: int, num_classes: int) -> np.ndarray:
     """[F, B, C] int64 array of each cell's row/col index in G (layout per
-    :func:`plan`) — the single source of truth for G readout and tests."""
+    :func:`plan`) — the single source of truth for G readout and tests.
+    In ``cls`` mode the index is within class c's [wp, wp] gram (G is
+    [C, wp, wp]); it is the same for every c."""
     mode, jcp, _ = plan(num_feat, num_bins, num_classes)
+    if mode == "cls":
+        w2 = np.arange(num_bins)[None, :] * num_feat \
+            + np.arange(num_feat)[:, None]
+        return np.repeat(w2[:, :, None], num_classes, axis=2).astype(np.int64)
     j = np.arange(num_bins)[:, None] * num_classes + np.arange(num_classes)
     if mode == "fmaj":
         return (np.arange(num_feat)[:, None, None] * jcp + j[None]).astype(
@@ -133,9 +168,12 @@ def w_index(num_feat: int, num_bins: int, num_classes: int) -> np.ndarray:
 def default_block_cols(wp: int, mode: str = "fmaj") -> int:
     """Column block sized so the expansion stays inside the ~110 MB VMEM
     budget the kernel compiles against.  fmaj materializes only the int8
-    [wp, BN] one-hot; jmaj also holds an int32 [wp, BN] block."""
+    [wp, BN] one-hot; jmaj/cls also hold an int32 [wp, BN] block (cls
+    further keeps the [C, wp, wp] accumulator resident)."""
     if mode == "fmaj":
         bn = min(_DEFAULT_BN, (72 * 1024 * 1024) // max(wp, 128))
+    elif mode == "cls":
+        bn = min(49152, (64 * 1024 * 1024) // (5 * max(wp, 128)))
     else:
         bn = 49152 * 384 // max(wp, 128)
     return max(128, (bn // 128) * 128)
@@ -191,6 +229,38 @@ def _cooc_kernel(codes_ref, labels_ref, out_ref, *, f: int, jc: int,
     out_ref[:] += acc
 
 
+def _cooc_cls_kernel(codes_ref, labels_ref, out_ref, *, f: int, b: int,
+                     wp: int, n: int, nclass: int):
+    """Per-class gram: one shared j-major expansion compare per block, a
+    class mask folded into the one-hot select, C sequential int8 dots."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ct = codes_ref[:]                                  # [F, BN] int32
+    y = labels_ref[:]                                  # [1, BN] int32
+    bn = ct.shape[1]
+    code = jnp.where((ct >= 0) & (ct < b), ct, _INVALID)
+    if n % bn or n == 0:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+        code = jnp.where(lane < n - i * bn, code, _INVALID)
+    w = f * b
+    jrept = jnp.concatenate([code] * b, axis=0)        # [W, BN]
+    if wp > w:
+        jrept = jnp.concatenate(
+            [jrept, jnp.full((wp - w, bn), _INVALID, jnp.int32)], axis=0)
+    jw = jax.lax.broadcasted_iota(jnp.int32, (wp, 1), 0)
+    jsel = jnp.where(jw < w, jw // f, _PAD_SEL)
+    hit = jrept == jsel                                # class-independent
+    for c in range(nclass):
+        xt = (hit & (y == c)).astype(jnp.int8)         # [Wp, BN]
+        acc = jax.lax.dot_general(xt, xt, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        out_ref[c] += acc
+
+
 @functools.partial(jax.jit, static_argnames=(
     "num_bins", "num_classes", "block_cols", "interpret"))
 def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
@@ -206,27 +276,36 @@ def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
     materialization anywhere (fused into the kernel)."""
     f, n = codes_t.shape
     mode, jcp, wp = plan(f, num_bins, num_classes)
+    out_shape = ((num_classes, wp, wp) if mode == "cls" else (wp, wp))
     if n == 0:
         # empty chunk (e.g. a stream's empty final block): zero counts,
         # matching the einsum path — the kernel's OOB block read would
         # not even trace on a zero-row operand
-        return jnp.zeros((wp, wp), jnp.int32)
+        return jnp.zeros(out_shape, jnp.int32)
     jc = num_bins * num_classes
     bn = block_cols or default_block_cols(wp, mode)
     ct = codes_t.astype(jnp.int32)
     y2 = labels.reshape(1, n).astype(jnp.int32)
     npad = _ru(max(n, bn), bn)
+    if mode == "cls":
+        kernel = functools.partial(_cooc_cls_kernel, f=f, b=num_bins,
+                                   wp=wp, n=n, nclass=num_classes)
+        out_specs = pl.BlockSpec((num_classes, wp, wp), lambda i: (0, 0, 0),
+                                 memory_space=pltpu.VMEM)
+    else:
+        kernel = functools.partial(_cooc_kernel, f=f, jc=jc, jcp=jcp, wp=wp,
+                                   n=n, nclass=num_classes, mode=mode)
+        out_specs = pl.BlockSpec((wp, wp), lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        functools.partial(_cooc_kernel, f=f, jc=jc, jcp=jcp, wp=wp, n=n,
-                          nclass=num_classes, mode=mode),
+        kernel,
         grid=(npad // bn,),
         in_specs=[pl.BlockSpec((f, bn), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
                   pl.BlockSpec((1, bn), lambda i: (0, i),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((wp, wp), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((wp, wp), jnp.int32),
+        out_specs=out_specs,
+        out_shape=jax.ShapeDtypeStruct(out_shape, jnp.int32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=110 * 1024 * 1024),
@@ -262,10 +341,17 @@ def counts_from_cooc(g, num_feat: int, num_bins: int, num_classes: int,
     g = np.asarray(g)
     b, c = num_bins, num_classes
     wf = w_index(num_feat, b, c)                             # [F, B, C]
-    fbc = g[wf, wf]
     ci = np.asarray(ci, np.int64)
     cj = np.asarray(cj, np.int64)
     p = len(ci)
+    if g.ndim == 3:                                          # cls mode
+        w2 = wf[:, :, 0]                                     # [F, B]
+        fbc = np.stack([g[k][w2, w2] for k in range(c)], axis=-1)
+        wi = np.broadcast_to(w2[ci][:, :, None], (p, b, b))
+        wj = np.broadcast_to(w2[cj][:, None, :], (p, b, b))
+        pair = np.stack([g[k][wi, wj] for k in range(c)], axis=-1)
+        return fbc, pair
+    fbc = g[wf, wf]
     wi = wf[ci][:, :, None, :]                               # [P, B, 1, C]
     wj = wf[cj][:, None, :, :]                               # [P, 1, B, C]
     pair = g[np.broadcast_to(wi, (p, b, b, c)),
@@ -288,10 +374,11 @@ def nb_mi_step(codes: jax.Array, labels: jax.Array, ci, cj,
 
 
 def applicable(num_feat: int, num_bins: int, num_classes: int) -> bool:
-    """Static shape gate: is the Xᵀ·X form profitable/compilable here?"""
+    """Static shape gate: is some Xᵀ·X form profitable/compilable here?"""
     if num_feat * num_bins * num_classes <= 0:
         return False
-    return plan(num_feat, num_bins, num_classes)[2] <= MAX_W
+    mode, _, wp = plan(num_feat, num_bins, num_classes)
+    return wp <= (MAX_W_CLS if mode == "cls" else MAX_W)
 
 
 def use_kernel(num_feat: int, num_bins: int, num_classes: int,
@@ -323,7 +410,7 @@ def chunk_pipeline(num_feat: int, num_bins: int, num_classes: int, ci, cj,
             return kernel(codes, labels, num_bins, num_classes)
 
         def chain_scalar(out):
-            return (out[0, 0] * 0).astype(jnp.int32)
+            return (out[(0,) * out.ndim] * 0).astype(jnp.int32)
 
         return step, chain_scalar, True
 

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Wide-schema NB+MI count throughput: cls-mode kernel vs the scatter einsum.
+
+The reference handles any cardinality via lazily-sparse reducer maps
+(``explore/MutualInformation.java:421-432``); round 3 covered F·B·C ≤ 768
+on the MXU and silently fell back to the ~80-113M rows/s scatter einsum
+above it.  Round 4's per-class gram mode ("cls" in ops/pallas_hist.plan)
+keeps wide shapes on the MXU; this bench measures both paths on the same
+data, fresh-process, chained-dispatch host-fetch sync.
+
+  python benchmarks/wide_schema_bench.py --shape 20x20x2 --path kernel
+  python benchmarks/wide_schema_bench.py --shape 24x32x2 --path einsum
+
+One (shape, path) per process run (fresh-process discipline).
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from avenir_tpu.ops import agg, pallas_hist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shape", default="20x20x2",
+                    help="FxBxC, e.g. 20x20x2 (W=800) or 24x32x2 (W=1536)")
+    ap.add_argument("--path", choices=["kernel", "einsum"], default="kernel")
+    ap.add_argument("--rows", type=int, default=4_000_000)
+    ap.add_argument("--chunks", type=int, default=4)
+    ap.add_argument("--passes", type=int, default=4)
+    args = ap.parse_args()
+    f, b, c = (int(x) for x in args.shape.split("x"))
+
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, b, size=(args.rows, f), dtype=np.int32)
+    labels = rng.integers(0, c, size=args.rows, dtype=np.int32)
+    pi = np.array([(i, j) for i in range(f) for j in range(i + 1, f)],
+                  np.int32).reshape(-1, 2)
+    ci, cj = jnp.asarray(pi[:, 0]), jnp.asarray(pi[:, 1])
+
+    if args.path == "kernel":
+        mode, jcp, wp = pallas_hist.plan(f, b, c)
+        assert mode == "cls", f"shape routes to {mode}, not cls"
+        dcodes = jnp.asarray(np.ascontiguousarray(codes.T))
+        dlabels = jnp.asarray(labels)
+
+        def step(bias):
+            return pallas_hist.cooc_counts_cols(dcodes, dlabels + bias, b, c)
+
+        def chain(out):
+            return (out[0, 0, 0] * 0).astype(jnp.int32)
+    else:
+        # the einsum path sweeps pairs in slices like MutualInformation.fit
+        dcodes = jnp.asarray(codes)
+        dlabels = jnp.asarray(labels)
+
+        def step(bias):
+            return agg.nb_mi_pipeline_step(dcodes, dlabels + bias, ci, cj,
+                                           c, b)
+
+        def chain(out):
+            return (out[0][0, 0, 0] * 0).astype(jnp.int32)
+
+    def timed_pass():
+        bias = jnp.int32(0)
+        t0 = time.perf_counter()
+        for _ in range(args.chunks):
+            out = step(bias)
+            bias = chain(out)
+        np.asarray(bias)
+        return args.chunks * args.rows / (time.perf_counter() - t0)
+
+    timed_pass()
+    timed_pass()
+    passes = [timed_pass() for _ in range(args.passes)]
+    med = float(np.median(passes))
+    line = {
+        "metric": "nb_mi_wide_schema_throughput",
+        "shape": args.shape, "w": f * b * c, "path": args.path,
+        "value": round(med, 1), "unit": "rows/sec/chip",
+        "passes_rows_per_sec": [round(p, 1) for p in passes],
+    }
+    if args.path == "kernel":
+        line["plan"] = list(pallas_hist.plan(f, b, c))
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()
