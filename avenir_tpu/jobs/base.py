@@ -314,9 +314,89 @@ class Job:
                 cont_ordinals=first.cont_ordinals)
         return (ds, lines) if want_lines else ds
 
+    # -- multi-process execution (the Hadoop N-machine analog) ---------------
+    @staticmethod
+    def process_grid():
+        """(process_index, process_count) under ``jax.distributed``
+        initialization; (0, 1) in a plain single-process run."""
+        import jax
+
+        try:
+            return jax.process_index(), jax.process_count()
+        except Exception:                              # pragma: no cover
+            return 0, 1
+
+    @classmethod
+    def is_output_writer(cls) -> bool:
+        """Single-writer output protocol: process 0 writes the part file
+        (Hadoop's reducer wrote through the OutputCommitter; here the
+        merged totals are replicated, so one designated writer suffices)."""
+        return cls.process_grid()[0] == 0
+
+    @classmethod
+    def distributed_plan(cls, conf: JobConfig, checkpointer):
+        """(owner, accumulator, distributed) for a streaming count job.
+
+        Under ``jax.distributed`` with ``stream.chunk.rows`` set, chunks
+        are assigned round-robin by index (``idx % nprocs == pid`` — the
+        analog of Hadoop handing each of N machines its input splits,
+        ``BayesianDistribution.java:82``), each process accumulates its own
+        partials, and :meth:`distributed_stream` merges the totals once at
+        end of stream. Checkpoint/resume is per-process-cursor shaped and
+        is not supported together with this mode."""
+        pid, nprocs = cls.process_grid()
+        if nprocs <= 1 or not conf.get("stream.chunk.rows"):
+            return None, (checkpointer.accumulator if checkpointer else None), False
+        if checkpointer is not None:
+            raise ConfigError(
+                "stream.checkpoint.dir is not supported with multi-process "
+                "execution (the cursor describes a single process's stream); "
+                "rely on per-chunk retry + job re-run instead")
+        from avenir_tpu.ops import agg
+
+        return (lambda idx: idx % nprocs == pid), agg.Accumulator(), True
+
+    @staticmethod
+    def distributed_stream(chunks, accumulator, rows_fn, merged: dict):
+        """Pass chunks through; at exhaustion, replace the accumulator's
+        totals with the across-process sum (``all_process_sum_state``) and
+        store the global row count in ``merged["rows"]`` — every model
+        ``fit`` reads its totals only after consuming the stream, so the
+        merge lands exactly between the last local chunk and finalization,
+        with zero per-model code.  The row count rides in the same single
+        packed gather, so every process — including one that owned no
+        chunks at all — executes exactly one identical collective."""
+        for ds in chunks:
+            yield ds
+        from avenir_tpu.parallel.mesh import all_process_sum_state
+
+        state = accumulator.state()
+        state["__rows__"] = np.asarray(rows_fn(), np.int64)
+        total = all_process_sum_state(state)
+        merged["rows"] = int(total.pop("__rows__"))
+        accumulator.load(total)
+
+    @classmethod
+    def distributed_fit(cls, fit, data, acc, merged: dict):
+        """Run a model ``fit`` over the distributed stream, tolerating a
+        process that owned zero chunks (more processes than chunks): its
+        stream is empty, so ``fit`` raises "no data" — but only AFTER the
+        end-of-stream merge collective ran, so its totals were (vacuously)
+        contributed and its peers never stall.  Such a process returns
+        None; it is never the output writer (process 0 always owns chunk
+        0).  A globally-empty input re-raises on every process, matching
+        single-process behavior."""
+        try:
+            return fit(data)
+        except ValueError as e:
+            if "no data" in str(e) and merged.get("rows", 0) > 0 \
+                    and not cls.is_output_writer():
+                return None
+            raise
+
     def encoded_data_source(self, conf: JobConfig, input_path: str,
                             counters: Counters, with_labels: bool = True,
-                            mesh=None, checkpointer=None):
+                            mesh=None, checkpointer=None, owner=None):
         """(encoder, data, rows_fn) for count-aggregation jobs whose model
         ``fit`` accepts either one EncodedDataset or a chunk iterable.
 
@@ -349,7 +429,8 @@ class Job:
 
             pairs = self.iter_encoded_retrying(
                 conf, input_path, enc, counters, with_labels=with_labels,
-                start=ckpt.start if ckpt else None, emit_cursor=True)
+                start=ckpt.start if ckpt else None, emit_cursor=True,
+                owner=owner)
             depth = conf.get_int("stream.prefetch.depth", 2)
             if depth > 0:
                 from avenir_tpu.runtime.feeder import DeviceFeeder
@@ -409,7 +490,8 @@ class Job:
                               counters: Counters,
                               with_labels: bool = True,
                               start: Optional[dict] = None,
-                              emit_cursor: bool = False):
+                              emit_cursor: bool = False,
+                              owner=None):
         """Stream encoded chunks with per-chunk retry — the streaming train
         path, gated by ``stream.chunk.rows``.
 
@@ -433,7 +515,13 @@ class Job:
         ``cardinality``, numeric ranges via ``min``/``max``), exactly the
         contract the reference's mappers rely on — with an open vocabulary
         the single-pass stream cannot assign stable codes, and
-        ``DatasetEncoder.transform`` raises ConfigError (non-retryable)."""
+        ``DatasetEncoder.transform`` raises ConfigError (non-retryable).
+
+        ``owner``: optional ``fn(chunk_index) -> bool`` chunk-assignment
+        predicate for multi-process runs — non-owned chunks are scanned
+        (to locate boundaries) but never parsed, encoded, or yielded; the
+        Hadoop analog is the JobTracker handing each mapper its input
+        splits."""
         from avenir_tpu.core.csv_io import read_csv_string
         from avenir_tpu.runtime import native
         from avenir_tpu.utils.retry import RetryPolicy, run_with_retry
@@ -455,22 +543,29 @@ class Job:
                     f"among the input files — the input changed since the "
                     f"checkpoint was written")
             all_files = all_files[all_files.index(start["file"]):]
+        skip = object()                      # non-owned chunk marker
         for fi, f in enumerate(all_files):
             offset = int(start["offset"]) if start and fi == 0 else 0
             while True:
-                def task(path=f, off=offset):
+                def task(path=f, off=offset, idx=i):
+                    mine = owner is None or owner(idx)
                     with open(path, "rb") as fh:
                         fh.seek(off)
                         raw: List[bytes] = []
-                        while len(raw) < chunk_rows:
+                        nraw = 0
+                        while nraw < chunk_rows:
                             ln = fh.readline()
                             if not ln:
                                 break
                             if ln.strip():
-                                raw.append(ln)
+                                nraw += 1
+                                if mine:
+                                    raw.append(ln)
                         end = fh.tell()
-                    if not raw:
+                    if not nraw:
                         return end, None
+                    if not mine:
+                        return end, skip
                     ncols = raw[0].rstrip(b"\r\n").count(delim.encode()) + 1
                     if use_native and ncols > encoder.max_ordinal(with_labels):
                         return end, native.encode_bytes(
@@ -484,6 +579,8 @@ class Job:
                 if ds is None:
                     break
                 i += 1
+                if ds is skip:
+                    continue
                 if emit_cursor:
                     rows_out += ds.num_rows
                     yield ds, {"file": f, "offset": offset, "chunk": i,

@@ -37,14 +37,27 @@ class MutualInformation(Job):
         schema = self.load_schema(conf)
         mesh = self.auto_mesh(conf)
         ckpt = self.stream_checkpointer(conf)
+        # multi-process execution: see BayesianDistribution.execute
+        owner, acc, distributed = self.distributed_plan(conf, ckpt)
         enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters,
                                                       mesh=mesh,
-                                                      checkpointer=ckpt)
+                                                      checkpointer=ckpt,
+                                                      owner=owner)
         names = [schema.field_by_ordinal(f.ordinal).name
                  for f in enc.binned_fields]
-        result = mi.MutualInformation(mesh=mesh).fit(
-            data, feature_names=names,
-            accumulator=ckpt.accumulator if ckpt else None)
+        merged: dict = {}
+        if distributed:
+            data = self.distributed_stream(data, acc, rows_fn, merged)
+            result = self.distributed_fit(
+                lambda d: mi.MutualInformation(mesh=mesh).fit(
+                    d, feature_names=names, accumulator=acc),
+                data, acc, merged)
+            if result is None:             # zero-chunk non-writer process
+                counters.set("Records", "Processed", merged["rows"])
+                return
+        else:
+            result = mi.MutualInformation(mesh=mesh).fit(
+                data, feature_names=names, accumulator=acc)
         lines: List[str] = []
         if conf.get_bool("output.mutual.info", True):
             lines.extend(result.to_lines(delim=delim))
@@ -57,10 +70,12 @@ class MutualInformation(Job):
             lines.append(f"featureScore:{algo}")
             lines.extend(
                 delim.join([names[f], f"{score:.6f}"]) for f, score in ranked)
-        write_output(output_path, lines)
+        rows = merged["rows"] if distributed else rows_fn()
+        if self.is_output_writer():
+            write_output(output_path, lines)
         if ckpt:
             ckpt.finish()
-        counters.set("Records", "Processed", rows_fn())
+        counters.set("Records", "Processed", rows)
 
 
 class _CorrelationJob(Job):

@@ -32,9 +32,12 @@ def on_host():
     pays a ~60 ms dispatch round-trip, so a ``finish()`` pass of ~100 small
     ops costs seconds while the math itself is microseconds. Wrapping the
     derived-statistics phase in ``with info.on_host():`` keeps it on the
-    local CPU. No-op when no CPU backend is registered."""
+    local CPU. No-op when no CPU backend is registered.  Must be a device
+    THIS process addresses: under ``jax.distributed``, ``jax.devices()``
+    lists every process's devices, and placing on another host's device
+    makes the result unfetchable."""
     try:
-        cpu = jax.devices("cpu")[0]
+        cpu = jax.local_devices(backend="cpu")[0]
     except RuntimeError:                   # pragma: no cover
         return contextlib.nullcontext()
     return jax.default_device(cpu)

@@ -256,3 +256,59 @@ def maybe_shard_batch(mesh, *arrays, data_axis: str = "data"):
         out = device_put_sharded_batch(mesh, *arrays, data_axis=data_axis)
         return out if len(arrays) > 1 else [out]
     return [None if a is None else jnp.asarray(a) for a in arrays]
+
+
+def all_process_sum_state(state: dict) -> dict:
+    """Deterministic across-process sum of an accumulator state tree —
+    the job layer's final "reduce" when streaming chunks are partitioned
+    over processes (the multi-host analog of Hadoop's single reducer over
+    per-mapper partials, e.g. BayesianDistribution.java:203-328).
+
+    A collective every process must enter, but key sets MAY differ (a
+    process that owned no chunks contributes nothing; a missing key counts
+    as zero) — everything is packed into ONE payload per process (a
+    length gather + one byte gather, so the collective sequence is
+    identical everywhere and the merge costs two cross-host round trips
+    total, not one per key).  Raw bytes are used because
+    ``process_allgather`` would silently downcast int64/float64 under the
+    default x64-off config.  Per-key sums run on host in ascending
+    process order — the fixed order keeps float accumulation
+    deterministic, and integer counts are exact in any order, so
+    distributed output files stay reproducible."""
+    if jax.process_count() == 1:
+        return {k: np.asarray(v) for k, v in state.items()}
+    import json as _json
+
+    from jax.experimental import multihost_utils
+
+    arrays = {k: np.ascontiguousarray(np.asarray(state[k]))
+              for k in sorted(state)}
+    header = _json.dumps(
+        [[k, a.dtype.str, list(a.shape)] for k, a in arrays.items()]).encode()
+    payload = header + b"\0" + b"".join(a.tobytes() for a in arrays.values())
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.array([len(payload)], np.int64))).reshape(-1)
+    buf = np.zeros(int(lens.max()), np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    out: dict = {}
+    for p in range(gathered.shape[0]):
+        raw = gathered[p, :int(lens[p])].tobytes()
+        head, _, body = raw.partition(b"\0")
+        off = 0
+        for key, dt, shape in _json.loads(head.decode()):
+            dtype = np.dtype(dt)
+            nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+            arr = np.frombuffer(body[off:off + nbytes],
+                                dtype=dtype).reshape(shape)
+            off += nbytes
+            if key in out:
+                if out[key].shape != arr.shape:
+                    raise ValueError(
+                        f"process {p} contributed {key!r} with shape "
+                        f"{arr.shape}, expected {out[key].shape} — schema "
+                        f"mismatch across processes")
+                out[key] = out[key] + arr
+            else:
+                out[key] = arr.copy()
+    return out

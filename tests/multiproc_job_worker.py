@@ -1,0 +1,55 @@
+"""Worker for the multi-process JOB/CLI contract test (test_multiprocess.py).
+
+Each process owns 4 virtual CPU devices and joins a jax.distributed run,
+then executes the SAME `get_job(name).run(conf, in, out)` call a user would
+— the multi-host analog of `hadoop jar avenir.jar BayesianDistribution ...`
+fanning out over a cluster (BayesianDistribution.java:82).  Chunks are
+round-robin assigned by the job layer, per-process partial counts are
+merged at end of stream, and only process 0 writes the part file.
+"""
+
+import os
+import sys
+
+
+def main():
+    port, pid, nprocs, workdir = sys.argv[1:5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "").replace(
+            "--xla_force_host_platform_device_count=8", "").strip() +
+        " --xla_force_host_platform_device_count=4").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.parallel.mesh import init_distributed
+
+    idx = init_distributed(coordinator_address=f"localhost:{port}",
+                           num_processes=int(nprocs), process_id=int(pid))
+    assert jax.process_count() == int(nprocs)
+
+    # third case: one 3000-row chunk over 2 processes — process 1 owns ZERO
+    # chunks and must still complete (vacuous merge contribution, no write)
+    for job_name, outdir, chunk_rows in [
+            ("BayesianDistribution", "out_nb_mp", "250"),
+            ("MutualInformation", "out_mi_mp", "250"),
+            ("BayesianDistribution", "out_nb_1chunk", "3000")]:
+        conf = JobConfig()
+        conf.set("feature.schema.file.path", os.path.join(workdir, "schema.json"))
+        conf.set("stream.chunk.rows", chunk_rows)
+        c = get_job(job_name).run(conf, os.path.join(workdir, "train.csv"),
+                                  os.path.join(workdir, outdir))
+        # merged counters must report the WHOLE input on every process
+        assert c.get("Records", "Processed") == 3000, c.get(
+            "Records", "Processed")
+        if idx == 0:
+            part = os.path.join(workdir, outdir, "part-00000")
+            assert os.path.exists(part), "writer process produced no output"
+    print(f"proc {idx} ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()
