@@ -78,36 +78,29 @@ class ClassPartitionGenerator(Job):
         parent_info = conf.get_float("parent.info")
         from avenir_tpu.parallel.mesh import maybe_shard_batch
         mesh = self.auto_mesh(conf)
-        labels, node_ids = maybe_shard_batch(
-            mesh, ds.labels, np.zeros(ds.num_rows, np.int32))
+        codes_dev, labels, node_ids = maybe_shard_batch(
+            mesh, ds.codes, ds.labels, np.zeros(ds.num_rows, np.int32))
+        # ONE device contraction for the whole job: the [F, B, 1, C] table;
+        # every candidate split's histogram derives from it on host (the
+        # same factoring DecisionTree.fit uses per level)
+        table = np.asarray(dtree.node_bin_class_counts(
+            codes_dev, node_ids, labels, 1, ds.num_classes, ds.max_bins))
         lines: List[str] = []
         out_distr = conf.get_bool("output.split.prob", False)
         split_chunk = conf.get_int("split.chunk", 128)
-        for a, splits in sorted(all_splits.items()):
+        for a, chunk, scores, hist in dtree.iter_scored_splits(
+                table, all_splits, p["algorithm"], split_chunk,
+                parent_info=parent_info):
             ordinal = ds.binned_ordinals[a]
-            col = ds.codes[:, a]
-            # batched scoring: [N, S] segment codes per chunk, one contraction
-            # (the same path DecisionTree.fit uses)
-            for s0 in range(0, len(splits), split_chunk):
-                chunk = splits[s0:s0 + split_chunk]
-                seg_tab = np.stack([sp.seg_of_bin for sp in chunk])   # [S, B]
-                seg_codes = seg_tab[:, col].T                         # [N, S]
-                gmax = max(sp.num_segments for sp in chunk)
-                hist = dtree.split_node_histograms(
-                    maybe_shard_batch(mesh, seg_codes)[0], node_ids, labels,
-                    gmax, 1, ds.num_classes)
-                scores = np.asarray(dtree.split_scores(
-                    hist, p["algorithm"], parent_info=parent_info))
-                hist_np = np.asarray(hist) if out_distr else None
-                for si, sp in enumerate(chunk):
-                    row = [str(ordinal), sp.key, f"{float(scores[si, 0]):.6f}"]
-                    if out_distr:
-                        hh = hist_np[si, :, 0, :]                     # [G, C]
-                        tot = np.maximum(hh.sum(-1, keepdims=True), 1e-9)
-                        for g in range(sp.num_segments):
-                            row.append(":".join(
-                                f"{v:.4f}" for v in (hh[g] / tot[g])))
-                    lines.append(";".join(row))
+            for si, sp in enumerate(chunk):
+                row = [str(ordinal), sp.key, f"{float(scores[si, 0]):.6f}"]
+                if out_distr:
+                    hh = hist[si, :, 0, :]                            # [G, C]
+                    tot = np.maximum(hh.sum(-1, keepdims=True), 1e-9)
+                    for g in range(sp.num_segments):
+                        row.append(":".join(
+                            f"{v:.4f}" for v in (hh[g] / tot[g])))
+                lines.append(";".join(row))
         write_output(output_path, lines)
         counters.set("Records", "Processed", ds.num_rows)
         counters.set("Splits", "Evaluated", len(lines))

@@ -92,8 +92,20 @@ class CategoricalCorrelation:
         b_dst = max(b, meta.num_classes) if against_class else b
         acc = agg.Accumulator()
         from avenir_tpu.parallel.mesh import maybe_shard_batch
+
+        # single-TPU fast path: feature-pair contingency tables are exactly
+        # the co-occurrence gram with ONE class (labels ≡ 0, W = F·B), so
+        # the MXU count kernel serves the Cramér/heterogeneity jobs too;
+        # the einsum stays for against_class mode, meshes, and CPU runs
+        from avenir_tpu.ops import pallas_hist
+        fast = (not against_class
+                and pallas_hist.use_kernel(f, b, 1, mesh=self.mesh))
         for ds in chunks:
             codes, lab = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
+            if fast:
+                zeros = jnp.zeros(codes.shape[0], jnp.int32)
+                acc.add("g", pallas_hist.cooc_counts(codes, zeros, b, 1))
+                continue
             for s in range(0, len(pairs), self.pair_chunk):
                 sl = pairs[s:s + self.pair_chunk]
                 ci = codes[:, [p[0] for p in sl]]
@@ -104,8 +116,17 @@ class CategoricalCorrelation:
                 else:
                     cj = codes[:, [p[1] for p in sl]]
                 acc.add(f"c{s}", agg.pair_counts(ci, cj, b_dst))
-        cont = (np.concatenate([acc.get(f"c{s}") for s in range(0, len(pairs), self.pair_chunk)])
-                if pairs else np.zeros((0, b_dst, b_dst), np.int64))
+        if "g" in acc:
+            _, pair4 = pallas_hist.counts_from_cooc(
+                acc.get("g"), f, b, 1,
+                np.array([p[0] for p in pairs], np.int64),
+                np.array([p[1] for p in pairs], np.int64))
+            cont = pair4[:, :, :, 0]                     # [P, B, B]
+        elif pairs:
+            cont = np.concatenate([acc.get(f"c{s}")
+                                   for s in range(0, len(pairs), self.pair_chunk)])
+        else:
+            cont = np.zeros((0, b_dst, b_dst), np.int64)
         # statistic over the true (rows, cols) support of each pair; tiny
         # tensors — keep the per-pair ops on the local CPU backend
         stat = np.zeros(len(pairs))

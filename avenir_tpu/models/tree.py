@@ -160,19 +160,90 @@ def generate_candidate_splits(
 # split evaluation on device
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("num_segments", "num_nodes", "num_classes"))
-def split_node_histograms(
-    seg_codes: jax.Array,    # [N, S] segment id of each record under each split
+# rows per f32-exact einsum block in node_bin_class_counts; module-level so
+# tests can shrink it to exercise the scanned multi-block path cheaply
+_EINSUM_BLOCK = 1 << 23
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes", "num_classes",
+                                             "num_bins"))
+def node_bin_class_counts(
+    codes: jax.Array,        # [N, F]
     node_ids: jax.Array,     # [N] active-node index (−1 = inactive/settled)
     labels: jax.Array,       # [N]
-    num_segments: int, num_nodes: int, num_classes: int,
+    num_nodes: int, num_classes: int, num_bins: int,
 ) -> jax.Array:
-    """[S, G, K, C] histograms — the whole reducer of the reference's
-    split-evaluation job as one contraction."""
-    oh_s = agg.one_hot(seg_codes, num_segments)          # [N, S, G]
-    oh_k = agg.one_hot(node_ids, num_nodes)              # [N, K]
-    oh_c = agg.one_hot(labels, num_classes)              # [N, C]
-    return jnp.einsum("nsg,nk,nc->sgkc", oh_s, oh_k, oh_c, precision="highest")
+    """[F, B, K, C] per-(feature bin, frontier node, class) counts — the
+    level's ONE device contraction (an fbc count over the composite
+    (node, class) code, i.e. an MXU matmul over one-hots; rows beyond the
+    f32-exact einsum block limit are scanned in count-neutral-padded
+    blocks with int32 accumulation, so any N is exact).
+
+    Every candidate split's [S, G, K, C] histogram is a tiny host
+    contraction of this table with the split's bin→segment one-hot
+    (:func:`split_histograms_from_table`) — independent of N.  This
+    replaces the round-3 per-split-chunk [N, S] segment-code gather +
+    upload, which measured ~8k rows/s on the dev rig because every split
+    chunk re-uploaded an N-row operand; the reference pays the analogous
+    cost as one MR shuffle per candidate-split evaluation
+    (ClassPartitionGenerator.java:199-230)."""
+    c = num_classes
+    valid = (node_ids >= 0) & (labels >= 0) & (labels < c)
+    comp = jnp.where(valid, node_ids * c + labels, -1)
+    kc = num_nodes * c
+
+    def block(cd, cp):
+        oh_b = agg.one_hot(cd, num_bins)               # [n, F, B]
+        oh_k = agg.one_hot(cp, kc)                     # [n, KC]
+        return jnp.einsum("nfb,nk->fbk", oh_b, oh_k,
+                          precision="highest").astype(jnp.int32)
+
+    n = codes.shape[0]
+    lim = _EINSUM_BLOCK            # f32-exact einsum counts per block
+    if n <= lim:
+        t = block(codes, comp)
+    else:
+        npad = -(-n // lim) * lim
+        cd = jnp.pad(codes, ((0, npad - n), (0, 0)), constant_values=-1)
+        cp = jnp.pad(comp, (0, npad - n), constant_values=-1)
+        f = codes.shape[1]
+        t = jax.lax.scan(
+            lambda acc, xs: (acc + block(xs[0], xs[1]), None),
+            jnp.zeros((f, num_bins, kc), jnp.int32),
+            (cd.reshape(-1, lim, f), cp.reshape(-1, lim)))[0]
+    return t.reshape(t.shape[0], t.shape[1], num_nodes, c)
+
+
+def split_histograms_from_table(table_a: np.ndarray,
+                                chunk: Sequence["CandidateSplit"],
+                                gmax: int) -> np.ndarray:
+    """table_a [B, K, C] (one attribute's slice of the level table) →
+    [S, G, K, C] histograms for a chunk of candidate splits — pure host
+    numpy over segment maps; no N-dependent work."""
+    seg_tab = np.stack([sp.seg_of_bin for sp in chunk])          # [S, B]
+    m = (seg_tab[:, None, :] == np.arange(gmax)[None, :, None])  # [S, G, B]
+    return np.einsum("sgb,bkc->sgkc", m, table_a)
+
+
+def iter_scored_splits(table: np.ndarray, all_splits, algorithm: str,
+                       split_chunk: int, attrs=None, parent_info=None):
+    """Yield (attr, chunk, scores [S, K], hist [S, G, K, C]) per candidate
+    split chunk, all derived from the level table on the LOCAL host
+    backend — the single scoring pipeline behind both DecisionTree.fit
+    and the ClassPartitionGenerator job."""
+    with info.on_host():
+        for a in (attrs if attrs is not None else sorted(all_splits)):
+            splits = all_splits[a]
+            if not splits:
+                continue
+            for s0 in range(0, len(splits), split_chunk):
+                chunk = splits[s0:s0 + split_chunk]
+                gmax = max(sp.num_segments for sp in chunk)
+                hist = split_histograms_from_table(table[a], chunk, gmax)
+                scores = np.asarray(split_scores(
+                    jnp.asarray(hist, jnp.float32), algorithm,
+                    parent_info=parent_info))
+                yield a, chunk, scores, hist
 
 
 def split_scores(hist: jax.Array, algorithm: str,
@@ -400,9 +471,11 @@ class DecisionTree:
 
         rng = np.random.default_rng(self.seed)
         n, c = ds.num_rows, ds.num_classes
-        # batch-sharded under a data mesh: pad rows carry -1 labels/node ids
-        # /segment codes, all count-neutral in the histogram contraction
+        # batch-sharded under a data mesh: pad rows carry -1 labels/node ids,
+        # all count-neutral in the level contraction. Codes and labels are
+        # uploaded ONCE; per level only the [N] node-id vector travels.
         labels_dev = maybe_shard_batch(self.mesh, ds.labels)[0]
+        codes_dev = maybe_shard_batch(self.mesh, ds.codes)[0]
         all_splits = generate_candidate_splits(
             ds, self.max_split, is_categorical, self.max_candidates_per_attr)
 
@@ -415,34 +488,26 @@ class DecisionTree:
             if not frontier:
                 break
             k = len(frontier)
-            # remap frontier ids to 0..k-1 for the histogram kernel
+            # remap frontier ids to 0..k-1 for the level contraction
             remap = np.full(len(nodes), -1, np.int32)
             for i, nid in enumerate(frontier):
                 remap[nid] = i
             local_node = remap[node_of_record]                 # −1 for settled rows
             local_node_dev = maybe_shard_batch(self.mesh, local_node)[0]
+            # ONE device round trip per level: the [F, B, K, C] table; all
+            # candidate histograms and scores derive from it on host
+            table = np.asarray(node_bin_class_counts(
+                codes_dev, local_node_dev, labels_dev, k, c, ds.max_bins))
 
             best_per_node: List[List[Tuple[float, CandidateSplit, np.ndarray]]] = [
                 [] for _ in range(k)]
-            for a in self._attrs_for_node(rng, ds.num_binned):
-                splits = all_splits[a]
-                if not splits:
-                    continue
-                col = ds.codes[:, a]
-                for s0 in range(0, len(splits), self.split_chunk):
-                    chunk = splits[s0:s0 + self.split_chunk]
-                    seg_tab = np.stack([sp.seg_of_bin for sp in chunk])     # [S, B]
-                    seg_codes = seg_tab[:, col].T                           # [N, S]
-                    gmax = max(sp.num_segments for sp in chunk)
-                    hist = split_node_histograms(
-                        maybe_shard_batch(self.mesh, seg_codes)[0],
-                        local_node_dev, labels_dev, gmax, k, c)
-                    scores = np.asarray(split_scores(hist, self.algorithm))  # [S, K]
-                    hist_np = np.asarray(hist)
-                    for si, sp in enumerate(chunk):
-                        for ki in range(k):
-                            best_per_node[ki].append((float(scores[si, ki]), sp,
-                                                      hist_np[si, :, ki, :]))
+            for _a, chunk, scores, hist in iter_scored_splits(
+                    table, all_splits, self.algorithm, self.split_chunk,
+                    attrs=self._attrs_for_node(rng, ds.num_binned)):
+                for si, sp in enumerate(chunk):
+                    for ki in range(k):
+                        best_per_node[ki].append((float(scores[si, ki]), sp,
+                                                  hist[si, :, ki, :]))
             # select per node: best or random among top_n
             new_frontier: List[int] = []
             for ki, nid in enumerate(frontier):

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Per-family device benchmarks — one measured number for every workload
+family the framework ships (VERDICT r3 item 6: "no workload family ships
+without a measured number").
+
+Families and shapes (reference-derived):
+- ``tree``     decision-tree induction on the retarget shape
+               (abandoned-cart retargeting, ``resource/retarget.py`` /
+               ``tree/DataPartitioner.java`` two-jobs-per-level ↔ the
+               in-memory frontier here); rows/s = rows / full-fit wall.
+- ``viterbi``  batch Viterbi decode, email-marketing-tutorial shape
+               (``resource/tutorial_opt_email_marketing.txt:15-18``):
+               80k sequences × 210 observations; seqs/s.
+- ``lr``       logistic-regression gradient iterations/s
+               (``regress/LogisticRegressionJob.java:279-289`` ran ONE
+               MR job per iteration; here one chained device step).
+- ``cramer``   Cramér-index contingency aggregation rows/s
+               (``explore/CramerCorrelation.java``).
+- ``wordcount``host tokenize+count tokens/s (``text/WordCounter.java``;
+               HOST-bound — on the 1-core dev rig this is a rig artifact,
+               see BASELINE.md e2e notes).
+
+Sync discipline: device-bound families chain dispatches and fetch once
+(block_until_ready is a no-op on the tunnel — BASELINE.md "Timing
+methodology"); tree/wordcount are host-driven loops whose wall-clock is
+already host-observed.  Run ONE family per process:
+
+  python -m benchmarks.family_bench --family viterbi
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def bench_tree(passes: int):
+    import jax
+
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.datagen.retarget import (RETARGET_SCHEMA_JSON,
+                                             generate_retarget)
+    from avenir_tpu.models import tree as dtree
+
+    n = 2_000_000
+    schema = FeatureSchema.from_json(RETARGET_SCHEMA_JSON)
+    rows = generate_retarget(n, seed=9)
+    enc = DatasetEncoder(schema)
+    ds = enc.fit_transform(rows)
+    is_cat = [f.is_categorical for f in schema.binned_feature_fields]
+    builder = dtree.DecisionTree(algorithm="entropy", max_depth=4,
+                                 max_split=3)
+    vals = []
+    model = builder.fit(ds, is_categorical=is_cat)       # compile + warm
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        model = builder.fit(ds, is_categorical=is_cat)
+        vals.append(n / (time.perf_counter() - t0))
+    return {"metric": "tree_induction_rows_per_sec", "unit": "rows/sec/chip",
+            "n_rows": n, "max_depth": 4, "nodes": len(model.nodes),
+            "shape": "retarget"}, vals
+
+
+def bench_viterbi(passes: int):
+    import jax
+    import jax.numpy as jnp
+
+    from avenir_tpu.models import markov as mk
+
+    r, t, s, o = 80_000, 210, 6, 12                      # email-mktg shape
+    rng = np.random.default_rng(0)
+    log_a = jnp.asarray(np.log(rng.dirichlet(np.ones(s), size=s)), jnp.float32)
+    log_b = jnp.asarray(np.log(rng.dirichlet(np.ones(o), size=s)), jnp.float32)
+    log_pi = jnp.asarray(np.log(rng.dirichlet(np.ones(s))), jnp.float32)
+    obs = jnp.asarray(rng.integers(0, o, size=(r, t), dtype=np.int32))
+    decode = jax.jit(mk._viterbi_batch)
+    out = decode(log_a, log_b, log_pi, obs)
+    np.asarray(out[0, 0])                                # compile + warm
+    vals = []
+    for _ in range(passes):
+        bias = jnp.int32(0)
+        t0 = time.perf_counter()
+        for _ in range(3):                               # chained dispatches
+            out = decode(log_a, log_b, log_pi, obs + bias * 0)
+            bias = out[0, 0] * 0
+        np.asarray(out[0, 0])
+        vals.append(3 * r / (time.perf_counter() - t0))
+    return {"metric": "viterbi_decode_seqs_per_sec", "unit": "seqs/sec/chip",
+            "n_seqs": r, "seq_len": t, "n_states": s,
+            "shape": "email_marketing_80kx210"}, vals
+
+
+def bench_lr(passes: int):
+    import jax
+    import jax.numpy as jnp
+
+    from avenir_tpu.models import logistic as lg
+
+    n, d = 4_000_000, 24
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((n, d), np.float32))
+    y = jnp.asarray((rng.random(n) < 0.5).astype(np.float32))
+    w = jnp.zeros(d, jnp.float32)
+    step = jax.jit(lg._grad_step)
+    nn = jnp.float32(n)
+    w1 = step(w, x, y, nn, jnp.float32(0.5), jnp.float32(0.01))
+    np.asarray(w1[0])                                    # compile + warm
+    iters = 20
+    vals = []
+    for _ in range(passes):
+        wi = w
+        t0 = time.perf_counter()
+        for _ in range(iters):                           # natural chain via w
+            wi = step(wi, x, y, nn, jnp.float32(0.5), jnp.float32(0.01))
+        np.asarray(wi[0])
+        vals.append(iters / (time.perf_counter() - t0))
+    return {"metric": "lr_iterations_per_sec", "unit": "iters/sec/chip",
+            "n_rows": n, "n_features": d,
+            "note": "one iteration == one full-batch gradient step == one "
+                    "MR job of the reference"}, vals
+
+
+def bench_cramer(passes: int):
+    import jax.numpy as jnp
+
+    from avenir_tpu.ops import pallas_hist
+
+    n, f, b = 16_000_000, 10, 20
+    rng = np.random.default_rng(0)
+    codes_t = jnp.asarray(rng.integers(0, b, size=(f, n), dtype=np.int32))
+    zeros = jnp.zeros(n, jnp.int32)
+    kernel = pallas_hist.use_kernel(f, b, 1)
+
+    def step(bias):
+        # all [B, B] contingency tables at once: the one-class gram —
+        # exactly CategoricalCorrelation.fit's single-TPU fast path
+        return pallas_hist.cooc_counts_cols(codes_t, zeros + bias, b, 1)
+
+    out = step(jnp.int32(0))
+    np.asarray(out[0, 0])
+    vals = []
+    for _ in range(passes):
+        bias = jnp.int32(0)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = step(bias)
+            bias = (out[0, 0] * 0).astype(jnp.int32)
+        np.asarray(out[0, 0])
+        vals.append(3 * n / (time.perf_counter() - t0))
+    return {"metric": "cramer_rows_per_sec", "unit": "rows/sec/chip",
+            "n_rows": n, "n_features": f, "cardinality": b,
+            "n_pairs": f * (f - 1) // 2, "kernel_path": bool(kernel),
+            "plan": list(pallas_hist.plan(f, b, 1))}, vals
+
+
+def bench_wordcount(passes: int):
+    from avenir_tpu.text.analyzer import tokenize
+
+    rng = np.random.default_rng(0)
+    vocab = [f"word{i}" for i in range(5000)]
+    lines = [" ".join(rng.choice(vocab, size=12)) for _ in range(20_000)]
+    n_tokens = sum(len(tokenize(s)) for s in lines)
+    vals = []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        counts: dict = {}
+        for s in lines:
+            for tok in tokenize(s):
+                counts[tok] = counts.get(tok, 0) + 1
+        vals.append(n_tokens / (time.perf_counter() - t0))
+    return {"metric": "wordcount_tokens_per_sec", "unit": "tokens/sec",
+            "n_tokens": n_tokens,
+            "note": "HOST-bound (tokenizer); 1-core dev rig number is a "
+                    "lower bound, scales with host cores"}, vals
+
+
+FAMILIES = {"tree": bench_tree, "viterbi": bench_viterbi, "lr": bench_lr,
+            "cramer": bench_cramer, "wordcount": bench_wordcount}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--family", choices=sorted(FAMILIES), required=True)
+    ap.add_argument("--passes", type=int, default=4)
+    args = ap.parse_args()
+    line, vals = FAMILIES[args.family](args.passes)
+    line["value"] = round(float(np.median(vals)), 1)
+    line["passes"] = [round(v, 1) for v in vals]
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

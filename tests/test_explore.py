@@ -128,6 +128,33 @@ def test_cramer_correlation_churn():
     assert res2.to_lines()[0].count(",") == 2
 
 
+def test_cramer_kernel_fast_path_matches_einsum(monkeypatch):
+    """CategoricalCorrelation.fit's cooc-kernel route (one-class gram,
+    forced on + interpret mode) must reproduce the einsum contingency
+    tables and statistics exactly."""
+    import functools
+
+    from avenir_tpu.ops import pallas_hist
+
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    rows = generate_churn(5000, seed=7)
+    ds = DatasetEncoder(schema).fit_transform(rows)
+    names = [f.name for f in schema.binned_feature_fields]
+    baseline = corr.CramerCorrelation().fit(ds, feature_names=names)
+    monkeypatch.setattr(pallas_hist, "on_tpu_single_device", lambda *a: True)
+    # pin the route: the schema must actually select the kernel fast path,
+    # otherwise this test compares the einsum with itself
+    assert pallas_hist.use_kernel(ds.num_binned, ds.max_bins, 1, mesh=None)
+    monkeypatch.setattr(
+        pallas_hist, "cooc_counts",
+        functools.partial(pallas_hist.cooc_counts.__wrapped__,
+                          interpret=True))
+    fast = corr.CramerCorrelation().fit(ds, feature_names=names)
+    np.testing.assert_array_equal(np.asarray(fast.contingency),
+                                  np.asarray(baseline.contingency))
+    np.testing.assert_allclose(fast.stat, baseline.stat, rtol=1e-6)
+
+
 def test_heterogeneity_correlation_consistency():
     schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
     rows = generate_churn(6000, seed=5)

@@ -359,3 +359,23 @@ def test_tree_builder_meshed_identical_to_single(tmp_path):
         str(tmp_path / "d.csv"), str(tmp_path / "t_single"))
     assert read_lines(str(tmp_path / "t_mesh")) == \
         read_lines(str(tmp_path / "t_single"))
+
+
+def test_node_bin_class_counts_blocked_path(monkeypatch):
+    """N beyond the f32-exact einsum block limit must take the scanned
+    multi-block path and produce identical int32 counts (limit shrunk so
+    the test stays cheap)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    n, f, b, k, c = 10_000, 4, 5, 3, 2
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    nodes = rng.integers(-1, k, size=n).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    one = np.asarray(dtree.node_bin_class_counts(
+        jnp.asarray(codes), jnp.asarray(nodes), jnp.asarray(labels), k, c, b))
+    monkeypatch.setattr(dtree, "_EINSUM_BLOCK", 1 << 12)   # 4096-row blocks
+    blocked = np.asarray(dtree.node_bin_class_counts(
+        jnp.asarray(codes[:, :3]), jnp.asarray(nodes), jnp.asarray(labels),
+        k, c, b))                                          # new shape: retrace
+    np.testing.assert_array_equal(blocked, one[:3])
