@@ -94,17 +94,20 @@ class CategoricalCorrelation:
         from avenir_tpu.parallel.mesh import maybe_shard_batch
 
         # single-TPU fast path: feature-pair contingency tables are exactly
-        # the co-occurrence gram with ONE class (labels ≡ 0, W = F·B), so
-        # the MXU count kernel serves the Cramér/heterogeneity jobs too;
-        # the einsum stays for against_class mode, meshes, and CPU runs
+        # the co-occurrence gram with ONE class (labels ≡ 0, W = F·B), and
+        # against_class tables are the gram's [F, B, C] diagonal with the
+        # real labels — so the MXU count kernel serves the Cramér/
+        # heterogeneity jobs in both modes; the einsum stays for meshes
+        # and CPU runs
         from avenir_tpu.ops import pallas_hist
-        fast = (not against_class
-                and pallas_hist.use_kernel(f, b, 1, mesh=self.mesh))
+        n_cls = meta.num_classes if against_class else 1
+        fast = pallas_hist.use_kernel(f, b, n_cls, mesh=self.mesh)
         for ds in chunks:
             codes, lab = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             if fast:
-                zeros = jnp.zeros(codes.shape[0], jnp.int32)
-                acc.add("g", pallas_hist.cooc_counts(codes, zeros, b, 1))
+                y = lab if against_class else jnp.zeros(codes.shape[0],
+                                                        jnp.int32)
+                acc.add("g", pallas_hist.cooc_counts(codes, y, b, n_cls))
                 continue
             for s in range(0, len(pairs), self.pair_chunk):
                 sl = pairs[s:s + self.pair_chunk]
@@ -116,7 +119,13 @@ class CategoricalCorrelation:
                 else:
                     cj = codes[:, [p[1] for p in sl]]
                 acc.add(f"c{s}", agg.pair_counts(ci, cj, b_dst))
-        if "g" in acc:
+        if "g" in acc and against_class:
+            fbc, _ = pallas_hist.counts_from_cooc(
+                acc.get("g"), f, b, n_cls, np.zeros(0, np.int64),
+                np.zeros(0, np.int64))                   # [F, B, C]
+            cont = np.zeros((len(pairs), b_dst, b_dst), fbc.dtype)
+            cont[:, :b, :n_cls] = fbc[src_idx]
+        elif "g" in acc:
             _, pair4 = pallas_hist.counts_from_cooc(
                 acc.get("g"), f, b, 1,
                 np.array([p[0] for p in pairs], np.int64),

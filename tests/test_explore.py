@@ -153,6 +153,18 @@ def test_cramer_kernel_fast_path_matches_einsum(monkeypatch):
     np.testing.assert_array_equal(np.asarray(fast.contingency),
                                   np.asarray(baseline.contingency))
     np.testing.assert_allclose(fast.stat, baseline.stat, rtol=1e-6)
+    # against_class mode rides the kernel too (fbc diagonal readout);
+    # pin its route for the MULTI-class shape as well
+    assert pallas_hist.use_kernel(ds.num_binned, ds.max_bins,
+                                  ds.num_classes, mesh=None)
+    base_ac = corr.CramerCorrelation().fit(ds, against_class=True,
+                                           feature_names=names)
+    monkeypatch.undo()
+    base_ac2 = corr.CramerCorrelation().fit(ds, against_class=True,
+                                            feature_names=names)
+    np.testing.assert_array_equal(np.asarray(base_ac.contingency),
+                                  np.asarray(base_ac2.contingency))
+    np.testing.assert_allclose(base_ac.stat, base_ac2.stat, rtol=1e-6)
 
 
 def test_heterogeneity_correlation_consistency():
