@@ -171,10 +171,19 @@ class MutualInformation:
         # [Wp, Wp] G matrix; the [F,B,C] tensor and every pair's [B,B,C]
         # joint are read out of the int64 G total ONCE at the end on host
         # (device-side extraction measured slower than the kernel itself).
-        # The einsum loop stays for meshes (its psum is the attested
-        # collective), wide tables, and CPU runs — bit-identical counts.
+        # TPU MESHES (round 4) run the same kernel under shard_map — each
+        # device grams its local rows and ONE psum over ``data`` merges
+        # (collectives.sharded_cooc_step, the shuffle analog the dryrun
+        # attests). The einsum loop remains for CPU runs, non-TPU meshes,
+        # and shapes past every kernel gate — bit-identical counts.
         from avenir_tpu.ops import pallas_hist
-        fast = pallas_hist.use_kernel(f, b, c, mesh=self.mesh)
+        step = None                        # kernel route when set
+        if pallas_hist.use_kernel(f, b, c, mesh=self.mesh):
+            step = lambda cd, lb: pallas_hist.cooc_counts(cd, lb, b, c)
+        elif (pallas_hist.applicable(f, b, c)
+                and pallas_hist.mesh_on_tpu(self.mesh)):
+            from avenir_tpu.parallel import collectives
+            step = collectives.sharded_cooc_step(self.mesh, b, c)
         gk = pallas_hist.g_key(f, b, c)
         # a checkpoint-restored accumulator dictates the path: counts from a
         # crashed run on the OTHER path must not be silently dropped. A
@@ -192,7 +201,7 @@ class MutualInformation:
                     f"checkpoint holds count matrix {stale[0]!r} from an "
                     f"incompatible kernel layout (this build uses {gk!r}); "
                     f"restart the job without --resume")
-            if gk in accumulator and not fast:
+            if gk in accumulator and step is None:
                 g = accumulator.state()
                 fc0, pcc0 = pallas_hist.counts_from_cooc(
                     g.pop(gk), f, b, c, pair_index[:, 0], pair_index[:, 1])
@@ -200,14 +209,14 @@ class MutualInformation:
                 for s in range(0, len(pair_index), self.pair_chunk):
                     g[f"pcc{s}"] = pcc0[s:s + self.pair_chunk]
                 accumulator.load(g)
-            elif "fc" in accumulator and fast:
-                fast = False
+            elif "fc" in accumulator and step is not None:
+                step = None
         for ds in chunks:
             from avenir_tpu.parallel.mesh import maybe_shard_batch
             codes, labels = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             acc.add("class", agg.class_counts(labels, c))
-            if fast:
-                acc.add(gk, pallas_hist.cooc_counts(codes, labels, b, c))
+            if step is not None:
+                acc.add(gk, step(codes, labels))
                 continue
             acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
             for s in range(0, len(pair_index), self.pair_chunk):

@@ -426,6 +426,23 @@ def chunk_pipeline(num_feat: int, num_bins: int, num_classes: int, ci, cj,
     return step, chain_scalar, False
 
 
+def mesh_on_tpu(mesh) -> bool:
+    """True when every device of ``mesh`` is a TPU — the gate for running
+    the compiled kernel under ``shard_map``
+    (``parallel/collectives.sharded_cooc_step``); CPU meshes (tests,
+    dryrun) run the same step with ``interpret=True`` instead."""
+    if mesh is None:
+        return False
+    try:
+        devices = list(np.asarray(mesh.devices).flat)
+    except Exception:                                   # pragma: no cover
+        return False
+    return bool(devices) and all(
+        d.platform == "tpu" or "tpu" in (getattr(d, "device_kind", "") or
+                                         "").lower()
+        for d in devices)
+
+
 def on_tpu_single_device(*arrays) -> bool:
     """Runtime gate: default backend is a TPU and no operand is sharded
     across devices (the sharded einsum path owns multi-device execution —

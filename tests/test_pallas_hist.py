@@ -155,6 +155,38 @@ def test_plan_routing():
     assert pallas_hist.plan(80, 40, 2)[0] != "cls"    # wcp 3200 > MAX_W_CLS
 
 
+def test_fit_sharded_kernel_path_matches_einsum(rng, monkeypatch):
+    """MutualInformation.fit's TPU-mesh kernel route (sharded_cooc_step
+    forced on via interpret mode over the 8-device CPU mesh) must produce
+    the identical result to the sharded einsum path."""
+    import functools
+
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.models.mutual_info import MutualInformation
+    from avenir_tpu.parallel import collectives, mesh as pmesh
+
+    codes = rng.integers(0, 6, size=(512, 5)).astype(np.int32)
+    labels = rng.integers(0, 2, size=512).astype(np.int32)
+
+    def mk():
+        return EncodedDataset(codes=codes, cont=np.zeros((512, 0), np.float32),
+                              labels=labels, n_bins=np.full(5, 6, np.int32),
+                              class_values=["0", "1"],
+                              binned_ordinals=list(range(5)))
+
+    m = pmesh.make_mesh(("data",))
+    baseline = MutualInformation(mesh=m).fit(mk())     # sharded einsum
+    monkeypatch.setattr(pallas_hist, "mesh_on_tpu", lambda mesh: True)
+    monkeypatch.setattr(
+        collectives, "sharded_cooc_step",
+        functools.partial(collectives.sharded_cooc_step, interpret=True))
+    fast = MutualInformation(mesh=m).fit(mk())
+    np.testing.assert_array_equal(fast.feature_class_counts,
+                                  baseline.feature_class_counts)
+    np.testing.assert_array_equal(fast.pair_class_counts,
+                                  baseline.pair_class_counts)
+
+
 def test_applicable_gate():
     assert pallas_hist.applicable(11, 12, 2)          # hosp_readmit: 264
     assert pallas_hist.applicable(40, 12, 2)          # 960 → cls mode now
