@@ -45,18 +45,22 @@ def make_data(n_rows: int, n_feat: int, n_bins: int, n_classes: int, seed: int =
 def numpy_reference_rows_per_sec(codes, labels, n_classes, n_bins):
     """Single-core numpy equivalent of the NB+MI count pass (per-record cost model
     of the reference's mapper+reducer). Computes the SAME work as the TPU
-    pipeline (all feature pairs) so vs_baseline compares like for like."""
+    pipeline (all feature pairs) so vs_baseline compares like for like.
+    Median of 3 reps: the 1-core host is contended by the tunnel relay, so
+    a single rep swings vs_baseline by 2× run-to-run."""
     n, f = codes.shape
     pairs = [(i, j) for i in range(f) for j in range(i + 1, f)]
-    t0 = time.perf_counter()
-    # NB: class-conditional counts
-    for fi in range(f):
-        np.add.at(np.zeros((n_bins, n_classes)), (codes[:, fi], labels), 1)
-    # MI: pairwise joint counts
-    for i, j in pairs:
-        np.add.at(np.zeros((n_bins, n_bins)), (codes[:, i], codes[:, j]), 1)
-    dt = time.perf_counter() - t0
-    return n / dt
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        # NB: class-conditional counts
+        for fi in range(f):
+            np.add.at(np.zeros((n_bins, n_classes)), (codes[:, fi], labels), 1)
+        # MI: pairwise joint counts
+        for i, j in pairs:
+            np.add.at(np.zeros((n_bins, n_bins)), (codes[:, i], codes[:, j]), 1)
+        rates.append(n / (time.perf_counter() - t0))
+    return float(np.median(rates))
 
 
 def main():
