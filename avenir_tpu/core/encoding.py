@@ -41,6 +41,15 @@ from avenir_tpu.core.csv_io import iter_csv_chunks
 OOV = "__OOV__"
 
 
+class NoDataError(ValueError):
+    """Raised when a fit stream yields zero chunks.
+
+    A dedicated type (not a message substring) because
+    ``jobs.base.distributed_fit`` must distinguish "this process owned zero
+    chunks of a non-empty job" from any other ValueError — matching on
+    exception text couples that control flow to wording."""
+
+
 @dataclass
 class EncodedDataset:
     """A fully-encoded batch (or whole dataset) ready for device transfer."""
@@ -101,7 +110,7 @@ def peek_chunks(data):
     it = iter([data] if isinstance(data, EncodedDataset) else data)
     meta = next(it, None)
     if meta is None:
-        raise ValueError("no data")
+        raise NoDataError("no data")
     return meta, itertools.chain([meta], it)
 
 

@@ -380,17 +380,17 @@ class Job:
     def distributed_fit(cls, fit, data, acc, merged: dict):
         """Run a model ``fit`` over the distributed stream, tolerating a
         process that owned zero chunks (more processes than chunks): its
-        stream is empty, so ``fit`` raises "no data" — but only AFTER the
+        stream is empty, so ``fit`` raises ``NoDataError`` — but only AFTER the
         end-of-stream merge collective ran, so its totals were (vacuously)
         contributed and its peers never stall.  Such a process returns
         None; it is never the output writer (process 0 always owns chunk
         0).  A globally-empty input re-raises on every process, matching
         single-process behavior."""
+        from avenir_tpu.core.encoding import NoDataError
         try:
             return fit(data)
-        except ValueError as e:
-            if "no data" in str(e) and merged.get("rows", 0) > 0 \
-                    and not cls.is_output_writer():
+        except NoDataError:
+            if merged.get("rows", 0) > 0 and not cls.is_output_writer():
                 return None
             raise
 

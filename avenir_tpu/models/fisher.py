@@ -68,7 +68,8 @@ class FisherDiscriminant:
             acc.add("s1", s1)
             acc.add("s2", s2)
         if meta is None:
-            raise ValueError("no data")
+            from avenir_tpu.core.encoding import NoDataError
+            raise NoDataError("no data")
         if meta.num_classes != 2:
             raise ValueError("Fisher discriminant requires exactly two classes")
         if meta.num_cont == 0:

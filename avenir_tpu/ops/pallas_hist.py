@@ -141,10 +141,12 @@ def g_key(num_feat: int, num_bins: int, num_classes: int) -> str:
     Layout-qualified so a snapshot written under a DIFFERENT kernel layout
     (e.g. the round-3 j-major key ``"g"``) can never be silently summed
     with this layout's counts — resume code must detect and reject it.
-    num_feat is part of the key because every mode's row index depends on
-    F while the padded G shape may not (two F values can share wp)."""
-    mode, jcp, _ = plan(num_feat, num_bins, num_classes)
-    return f"g:{mode}:{jcp}:f{num_feat}"
+    The w_index layout is a pure function of (F, B, C), so the key carries
+    all three: keying on derived quantities alone (mode, jcp, wp) collides
+    for distinct schemas — e.g. (F=11,B=12,C=2) and (F=11,B=8,C=4) share
+    ('fmaj', 32, 384) but place j = bin·C + cls differently."""
+    mode, _, _ = plan(num_feat, num_bins, num_classes)
+    return f"g:{mode}:f{num_feat}:b{num_bins}:c{num_classes}"
 
 
 def w_index(num_feat: int, num_bins: int, num_classes: int) -> np.ndarray:

@@ -286,8 +286,16 @@ def all_process_sum_state(state: dict) -> dict:
     header = _json.dumps(
         [[k, a.dtype.str, list(a.shape)] for k, a in arrays.items()]).encode()
     payload = header + b"\0" + b"".join(a.tobytes() for a in arrays.values())
+    # int32 explicitly: process_allgather silently downcasts int64 under
+    # x64-off (the very reason the payload rides as raw bytes), so an
+    # int64 length gather would truncate >2^31-byte payloads silently —
+    # assert instead.
+    if len(payload) >= 2 ** 31:
+        raise ValueError(
+            f"accumulator payload {len(payload)} bytes exceeds the int32 "
+            "length-gather limit; shard the state across keys/jobs")
     lens = np.asarray(multihost_utils.process_allgather(
-        np.array([len(payload)], np.int64))).reshape(-1)
+        np.array([len(payload)], np.int32))).reshape(-1)
     buf = np.zeros(int(lens.max()), np.uint8)
     buf[:len(payload)] = np.frombuffer(payload, np.uint8)
     gathered = np.asarray(multihost_utils.process_allgather(buf))

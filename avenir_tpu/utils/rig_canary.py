@@ -1,0 +1,99 @@
+"""Rig-state canaries — tiny bare-XLA probes that separate "the rig is slow
+right now" from "a kernel regressed".
+
+Motivation (round 5): the driver's BENCH_r04 captured a kNN median 45%
+below the published band with the kernel code unchanged since round 3 —
+the fourth consecutive round where a published kNN number and an
+arm's-length capture disagreed.  Absolute rates on the dev rig swing ±20%
+on ~30-minute scales (BASELINE.md "Timing methodology") and the tunnel
+transport adds its own modes, so every benchmark artifact now carries two
+bare-XLA reference timings measured in the same process, moments before
+the headline measurement:
+
+- ``matmul_4096_bf16_ms`` — a chained 4096x4096x4096 bf16 matmul
+  (137 GFLOP/call).  Pure MXU + HBM; no custom kernels, no framework
+  code — if this is slow, the rig is slow.  The healthy band is
+  established empirically by the artifacts that carry the field (round-2
+  notes measured ~6.5 ms through the tunnel).
+- ``knn_dot_ms`` (kNN artifacts only) — the bare distance dot at the kNN
+  serving shape ([batch, 128] x [1M, 128]^T bf16), the measured lower
+  bound the fused search kernel is judged against
+  (docs/architecture.md "ceilings").  If headline QPS drops while this
+  stays put, the kernel (or its memory layout) regressed; if both drop by
+  the same factor, the rig did.
+
+Timing uses the chained-dispatch discipline: ``jax.block_until_ready`` is
+a no-op on the tunnel transport, so each call feeds a reduced scalar of
+the previous result into its operand and one host fetch at the end
+barriers the whole chain.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _chained_ms(step, operand, reps: int) -> float:
+    """Per-call ms of ``step(operand + bias)`` over a dependency chain.
+
+    ``step`` must return an array; a scalar of call i's result biases call
+    i+1's operand so the final host fetch waits for every call."""
+    bias = jnp.zeros((), operand.dtype)
+    out = step(operand + bias)                  # compile + warm
+    np.asarray(jax.device_get(out.ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = step(operand + bias)
+        bias = (out.ravel()[0] * 0).astype(operand.dtype)
+    np.asarray(jax.device_get(out.ravel()[0]))
+    return (time.perf_counter() - t0) * 1e3 / reps
+
+
+def matmul_canary_ms(dim: int = 4096, reps: int = 4) -> float:
+    """Chained ``dim³`` bf16 matmul, per-call ms (2·dim³ FLOPs/call)."""
+    a = jnp.asarray(np.random.default_rng(0).normal(
+        size=(dim, dim)).astype(np.float32)).astype(jnp.bfloat16)
+    step = jax.jit(lambda x: jnp.dot(x, a, preferred_element_type=jnp.float32)
+                   .astype(jnp.bfloat16))
+    return _chained_ms(step, a, reps)
+
+
+def knn_dot_canary_ms(batch: int = 16384, n_refs: int = 1_000_000,
+                      width: int = 128, reps: int = 3,
+                      refs=None) -> float:
+    """Chained bare distance dot at the kNN serving shape, per-call ms.
+
+    ``refs`` may pass an existing device-resident [n_refs, width] bf16
+    operand (e.g. the actual packed reference matrix) so the canary times
+    the dot against the very buffer the kernel reads; by default it
+    uploads a fresh one.
+    """
+    rng = np.random.default_rng(0)
+    if refs is None:
+        refs = jnp.asarray(rng.normal(size=(n_refs, width))
+                           .astype(np.float32)).astype(jnp.bfloat16)
+    q = jnp.asarray(rng.normal(size=(batch, width))
+                    .astype(np.float32)).astype(jnp.bfloat16)
+    # scan over reference tiles with a running max: the monolithic
+    # [batch, n_refs] f32 dot output would be ~65 GB at the serving shape
+    # (XLA:TPU does not fuse a reduce into a matmul) — one [batch, TILE]
+    # tile lives at a time (~1 GB), matching how the real kernel streams
+    tile = 16384
+    n = refs.shape[0] - refs.shape[0] % tile
+    r_tiles = refs[:n].reshape(-1, tile, refs.shape[1])
+
+    def step_fn(x):
+        def body(best, r):
+            d = jnp.dot(x, r.T, preferred_element_type=jnp.float32)
+            return jnp.maximum(best, d.max(axis=1)), None
+        init = jnp.full((x.shape[0],), -jnp.inf, jnp.float32)
+        best, _ = jax.lax.scan(body, init, r_tiles)
+        return best
+
+    step = jax.jit(step_fn)
+    return _chained_ms(step, q, reps)

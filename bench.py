@@ -50,20 +50,33 @@ def numpy_reference_rows_per_sec(codes, labels, n_classes, n_bins):
     a single rep swings vs_baseline by 2× run-to-run."""
     n, f = codes.shape
     pairs = [(i, j) for i in range(f) for j in range(i + 1, f)]
+    # Buffers hoisted out of the timed loop (round-5 fix): allocating them
+    # per feature/pair inside the timing mildly understated the baseline and
+    # thus inflated vs_baseline. The persistent-accumulator shape also
+    # matches the reference mapper, which reuses its count maps.
+    nb_buf = np.zeros((n_bins, n_classes))
+    pair_buf = np.zeros((n_bins, n_bins))
     rates = []
     for _ in range(3):
         t0 = time.perf_counter()
         # NB: class-conditional counts
         for fi in range(f):
-            np.add.at(np.zeros((n_bins, n_classes)), (codes[:, fi], labels), 1)
+            np.add.at(nb_buf, (codes[:, fi], labels), 1)
         # MI: pairwise joint counts
         for i, j in pairs:
-            np.add.at(np.zeros((n_bins, n_bins)), (codes[:, i], codes[:, j]), 1)
+            np.add.at(pair_buf, (codes[:, i], codes[:, j]), 1)
         rates.append(n / (time.perf_counter() - t0))
     return float(np.median(rates))
 
 
 def main():
+    # Rig-state canary FIRST (round 5): a bare-XLA 4096³ bf16 matmul,
+    # measured before any framework kernel touches the chip, so every
+    # artifact separates "rig slow" from "kernel regressed"
+    # (utils/rig_canary.py).
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
+    canary_ms = matmul_canary_ms()
+
     n_classes, n_bins, n_feat = 2, 12, 11      # hosp_readmit-shaped workload
     # 16M-row chunks amortize fixed per-dispatch cost (honest-sync
     # methodology; BASELINE.md) and stay under the 2^24 exact-count chunk
@@ -142,8 +155,11 @@ def main():
     # GB/s at these rates, so both resources are reported
     from avenir_tpu.utils.roofline import chip_peaks, mfu_fields
     bytes_per_row = 4 * (n_feat + 1)
-    wp = pallas_hist.plan(n_feat, n_bins, n_classes)[2]
-    int8_ops_per_row = 2 * wp * wp if kernel_path else 0
+    mode, _, wp = pallas_hist.plan(n_feat, n_bins, n_classes)
+    # cls mode performs C sequential wp×wp grams per block → 2·C·wp² MACs
+    # per row; the joint modes do one wp×wp gram (2·wp²).
+    per_row = 2 * n_classes * wp * wp if mode == "cls" else 2 * wp * wp
+    int8_ops_per_row = per_row if kernel_path else 0
     line = {
         "metric": "nb_mi_pipeline_throughput",
         "value": round(rows_per_sec, 1),
@@ -152,6 +168,7 @@ def main():
         "passes_rows_per_sec": [round(p, 1) for p in passes],
         "count_path": "pallas_cooc_int8_mxu" if kernel_path else "einsum",
         "finalize_ms": round(finalize_ms, 3),
+        "canary_matmul_4096_bf16_ms": round(canary_ms, 2),
     }
     line.update(mfu_fields(
         bytes_moved=n_chunks * chunk * bytes_per_row,
@@ -172,7 +189,8 @@ def main():
         line["knn"] = {kf: knn[kf] for kf in
                        ("value", "unit", "k", "batch", "n_refs",
                         "pipelined_passes_qps", "single_shot_qps",
-                        "verified_vs_oracle", "mfu_pct")
+                        "verified_vs_oracle", "mfu_pct",
+                        "canary_matmul_4096_bf16_ms", "canary_knn_dot_ms")
                        if kf in knn}
     print(json.dumps(line))
 

@@ -78,6 +78,8 @@ def measure(verify: bool = False, n_queries: int | None = None,
     ``quick`` skips the approx-engine comparison (bench.py embeds only the
     primary QPS + verification)."""
     import os
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms, knn_dot_canary_ms
+    canary_ms = matmul_canary_ms()           # rig state BEFORE any kNN work
     rng = np.random.default_rng(0)
     n_refs, k = 1_000_000, 10
     if n_queries is None:
@@ -103,6 +105,12 @@ def measure(verify: bool = False, n_queries: int | None = None,
     nb = int(model.n_bins.max())
     r_mat, n = model.device_packed(nb)
     cr_dev, cx_dev = model.device_rerank_arrays()
+    # bare distance-dot canary against the ACTUAL packed reference buffer:
+    # the measured lower bound the fused kernel is judged against — if QPS
+    # moves while this stays put, the kernel regressed; if both move
+    # together, the rig did (docs/architecture.md "ceilings")
+    dot_ms = knn_dot_canary_ms(batch=n_queries, refs=r_mat,
+                               width=r_mat.shape[1])
     batches = []
     for i in range(6):
         t = make_ds(rng, n_queries)
@@ -138,6 +146,8 @@ def measure(verify: bool = False, n_queries: int | None = None,
         "n_refs": n_refs,
         "pipelined_passes_qps": [round(p, 1) for p in passes],
         "single_shot_qps": round(n_queries / best, 1),
+        "canary_matmul_4096_bf16_ms": round(canary_ms, 2),
+        "canary_knn_dot_ms": round(dot_ms, 2),
     }
     if verified is not None:
         line["verified_vs_oracle"] = verified
