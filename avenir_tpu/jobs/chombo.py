@@ -160,6 +160,9 @@ class NumericalAttrStats(Job):
         from avenir_tpu.jobs.base import read_input
         from avenir_tpu.ops import agg
 
+        if conf.get("stream.chunk.rows"):
+            self._execute_streaming(conf, input_path, output_path, counters)
+            return
         delim = conf.field_delim_regex
         rows = read_input(input_path, delim=delim)
         attr_ords = conf.get_int_list("attr.list", None)
@@ -238,3 +241,139 @@ class NumericalAttrStats(Job):
                 lines.append(d.join(fields))
         write_output(output_path, lines)
         counters.set("Records", "Processed", len(rows))
+
+    # -- streaming / multi-process path --------------------------------------
+    def _execute_streaming(self, conf: JobConfig, input_path: str,
+                           output_path: str, counters: Counters) -> None:
+        """``stream.chunk.rows`` path: chunked raw-line stream (owner-
+        assigned under jax.distributed — the reference ran this chombo Tool
+        across N machines like every MR job), one moment snapshot PER
+        (chunk, group) merged at end of stream, finalized in global chunk
+        order.
+
+        Byte-identical for any process count BY CONSTRUCTION: each chunk's
+        snapshot is computed identically by whichever process owns it
+        (shift = the chunk's own per-group finite mean keeps the f32 device
+        moments stable), snapshots ride unique keys through the union merge
+        (never summed), and finalization translates every snapshot to the
+        group's lowest-chunk anchor shift and folds in ascending chunk
+        index — the f64 addition sequence does not depend on nprocs."""
+        import numpy as np
+
+        from avenir_tpu.core.config import ConfigError
+        from avenir_tpu.ops import agg
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+
+        if conf.get("stream.checkpoint.dir"):
+            raise ConfigError(
+                "stream.checkpoint.dir is not supported on the "
+                "NumericalAttrStats streaming path (per-chunk snapshots are "
+                "merge keys, not a resumable cursor) — configuring it must "
+                "fail loudly rather than silently run without durability")
+        delim = conf.field_delim_regex
+        attr_ords = conf.get_int_list("attr.list", None)
+        if attr_ords is None:
+            try:
+                schema = self.load_schema(conf)
+                attr_ords = [f.ordinal for f in schema.feature_fields
+                             if f.is_numeric]
+            except ValueError:
+                raise ConfigError(
+                    "streaming NumericalAttrStats needs attr.list or "
+                    "feature.schema.file.path (column count is unknown "
+                    "before the first chunk)")
+        cond_ord = conf.get_int("cond.attr.ord")
+        owner, _acc, distributed = self.distributed_plan(conf, None)
+        mesh = self.auto_mesh(conf)
+        a = len(attr_ords)
+        state: dict = {}
+        nrows = 0
+        for idx, lines in self.iter_line_chunks_retrying(
+                conf, input_path, counters, owner=owner, emit_index=True):
+            rows = np.array([ln.split(delim) for ln in lines], dtype=object)
+            nrows += len(rows)
+            vals64 = rows[:, attr_ords].astype(np.float64)
+            if cond_ord is not None:
+                cond_vals = [str(v) for v in rows[:, cond_ord]]
+                uniq = sorted(set(cond_vals))
+                cmap = {v: i for i, v in enumerate(uniq)}
+                labels = np.asarray([cmap[v] for v in cond_vals], np.int32)
+            else:
+                uniq = [""]
+                labels = np.zeros(len(rows), np.int32)
+            # per-(chunk, group) finite-mean shift — same stabilization as
+            # the whole-input path, anchored per chunk (translated to a
+            # global anchor at finalize)
+            shift = np.zeros((len(uniq), a))
+            for ci in range(len(uniq)):
+                sel = vals64[labels == ci]
+                fin = np.isfinite(sel)
+                n_fin = fin.sum(axis=0)
+                shift[ci] = np.where(
+                    n_fin > 0,
+                    np.where(fin, sel, 0.0).sum(axis=0) / np.maximum(n_fin, 1),
+                    0.0)
+            vals = (vals64 - shift[labels]).astype(np.float32)
+            vals_b, labels_b = maybe_shard_batch(mesh, vals, labels)
+            cnt, s1, s2 = (np.asarray(t, np.float64) for t in
+                           agg.class_moments(vals_b, labels_b, len(uniq)))
+            for ci, g in enumerate(uniq):
+                if not cnt[ci]:
+                    continue
+                sel = vals64[labels == ci]
+                state[f"c{idx:08d}:{g}"] = np.stack([
+                    np.full(a, cnt[ci]), s1[ci], s2[ci], shift[ci],
+                    sel.min(axis=0), sel.max(axis=0)])
+        merged_rows = nrows
+        if distributed:
+            from avenir_tpu.parallel.mesh import all_process_sum_state
+            state["__rows__"] = np.array([nrows], np.int64)
+            state = all_process_sum_state(state)
+            merged_rows = int(state.pop("__rows__")[0])
+
+        # finalize: group → snapshots in ascending chunk order
+        by_group: dict = {}
+        for k in sorted(state):                    # ascending chunk index
+            by_group.setdefault(k[10:], []).append(state[k])
+        d = conf.field_delim
+        out: List[str] = []
+        totals = {}
+        for g, snaps in by_group.items():
+            anchor = snaps[0][3]                             # [A] m*
+            n_tot = np.zeros(a)
+            s1_tot = np.zeros(a)
+            s2_tot = np.zeros(a)
+            mn = np.full(a, np.inf)
+            mx = np.full(a, -np.inf)
+            for snap in snaps:
+                n_c, s1_c, s2_c, m_c, mn_c, mx_c = snap
+                dm = m_c - anchor
+                n_tot = n_tot + n_c
+                s1_tot = s1_tot + (s1_c + n_c * dm)
+                s2_tot = s2_tot + (s2_c + 2.0 * dm * s1_c + n_c * dm * dm)
+                mn = np.minimum(mn, mn_c)
+                mx = np.maximum(mx, mx_c)
+            totals[g] = (anchor, n_tot, s1_tot, s2_tot, mn, mx)
+        for ai, aord in enumerate(attr_ords):
+            for g in sorted(totals):
+                anchor, n_tot, s1_tot, s2_tot, mn, mx = totals[g]
+                n = n_tot[ai]
+                if not n:
+                    continue
+                m = float(anchor[ai])
+                mean_s = s1_tot[ai] / n
+                var = max(s2_tot[ai] / n - mean_s * mean_s, 0.0)
+                raw_sum = s1_tot[ai] + n * m
+                raw_sumsq = s2_tot[ai] + 2.0 * m * s1_tot[ai] + n * m * m
+                fields = [str(aord)] + ([g] if cond_ord is not None else [])
+                fields += [_fmt(float(n)), _fmt_full(float(raw_sum)),
+                           _fmt_full(float(raw_sumsq)),
+                           _fmt_full(float(mean_s + m)),
+                           _fmt_full(float(var)),
+                           _fmt_full(float(np.sqrt(var))),
+                           _fmt_full(float(mn[ai])),
+                           _fmt_full(float(mx[ai]))]
+                out.append(d.join(fields))
+        if self.is_output_writer():
+            write_output(output_path, out)
+        counters.set("Records", "Processed", merged_rows)

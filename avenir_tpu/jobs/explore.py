@@ -89,8 +89,16 @@ class _CorrelationJob(Job):
         delim = conf.field_delim
         schema = self.load_schema(conf)
         mesh = self.auto_mesh(conf)
+        ckpt = self.stream_checkpointer(conf)
+        # multi-process execution: see BayesianDistribution.execute — the
+        # reference ran this same Tool across N machines
+        # (CramerCorrelation.java:83); contingency counts are exact
+        # integers, so the end-of-stream merge is order-free
+        owner, acc, distributed = self.distributed_plan(conf, ckpt)
         enc, data, rows_fn = self.encoded_data_source(conf, input_path, counters,
-                                                      mesh=mesh)
+                                                      mesh=mesh,
+                                                      checkpointer=ckpt,
+                                                      owner=owner)
         binned_ords = [f.ordinal for f in enc.binned_fields]
         names = [schema.field_by_ordinal(o).name for o in binned_ords]
         # source/dest attribute lists arrive as schema ordinals
@@ -102,16 +110,27 @@ class _CorrelationJob(Job):
         against_class = dst is not None and class_ord is not None and dst == [class_ord]
         job = corr.CategoricalCorrelation(algorithm=self._algorithm(conf),
                                           mesh=mesh)
-        result = job.fit(
-            data,
+        fit = lambda d: job.fit(
+            d,
             src=[ord_to_idx[o] for o in src] if src else None,
             dst=(None if against_class or dst is None
                  else [ord_to_idx[o] for o in dst]),
             against_class=against_class,
             feature_names=names,
+            accumulator=acc,
         )
-        write_output(output_path, result.to_lines(delim=delim))
-        counters.set("Records", "Processed", rows_fn())
+        merged: dict = {}
+        if distributed:
+            data = self.distributed_stream(data, acc, rows_fn, merged)
+            result = self.distributed_fit(fit, data, acc, merged)
+        else:
+            result = fit(data)
+        rows = merged["rows"] if distributed else rows_fn()
+        if result is not None and self.is_output_writer():
+            write_output(output_path, result.to_lines(delim=delim))
+        if ckpt:
+            ckpt.finish()
+        counters.set("Records", "Processed", rows)
 
 
 class CramerCorrelation(_CorrelationJob):

@@ -35,6 +35,45 @@ def _sequences(path: str, delim: str, skip: int = 1) -> List[List[str]]:
     return [[t for t in row[skip:] if t != ""] for row in _seq_rows(path, delim)]
 
 
+def _fit_streaming(job: Job, conf, input_path, counters, fit_chunks_fn,
+                   delim, skip):
+    """Shared streaming/distributed driver for sequence-model jobs: chunked
+    line stream (owner-assigned under jax.distributed), end-of-stream
+    partial merge, rows counter set to the GLOBAL sequence count on every
+    process."""
+    if conf.get("stream.checkpoint.dir"):
+        from avenir_tpu.core.config import ConfigError
+        raise ConfigError(
+            "stream.checkpoint.dir is not supported on the sequence-model "
+            "streaming path (no cursor snapshots are wired for ragged line "
+            "streams yet) — configuring it must fail loudly rather than "
+            "silently run without durability; rely on per-chunk retry + "
+            "job re-run, or unset the key")
+    owner, acc, distributed = job.distributed_plan(conf, None)
+    box = {"n": 0}
+
+    def seq_chunks():
+        for lines in job.iter_line_chunks_retrying(
+                conf, input_path, counters, owner=owner):
+            box["n"] += len(lines)
+            yield [[t for t in ln.split(delim)[skip:] if t != ""]
+                   for ln in lines]
+
+    merged: dict = {}
+    data = seq_chunks()
+    if distributed:
+        from avenir_tpu.ops import agg
+        acc = acc if acc is not None else agg.Accumulator()
+        data = job.distributed_stream(data, acc, lambda: box["n"], merged)
+        model = job.distributed_fit(
+            lambda d: fit_chunks_fn(d, acc), data, acc, merged)
+    else:
+        model = fit_chunks_fn(data, acc)
+    counters.set("Records", "Processed",
+                 merged["rows"] if distributed else box["n"])
+    return model
+
+
 class MarkovStateTransitionModel(Job):
     """First-order transition matrix with Laplace smoothing; int-scaled rows
     when ``trans.prob.scale`` > 1 (StateTransitionProbability.java:65-95)."""
@@ -45,16 +84,36 @@ class MarkovStateTransitionModel(Job):
                 counters: Counters) -> None:
         delim = conf.field_delim_regex
         skip = conf.get_int("skip.field.count", 1)
-        seqs = _sequences(input_path, delim, skip)
         states = conf.get_list("model.states")
         enc = mk.SequenceEncoder(states) if states else None
         scale = conf.get_int("trans.prob.scale", 1)
-        model, enc = mk.MarkovChain(
+        chain = mk.MarkovChain(
             mesh=self.auto_mesh(conf),
             laplace=conf.get_float("laplace.smoothing", 1.0),
-            scale=scale if scale > 1 else None).fit(seqs, encoder=enc)
-        write_output(output_path, model.to_lines(delim=conf.field_delim))
-        counters.set("Records", "Processed", len(seqs))
+            scale=scale if scale > 1 else None)
+        if conf.get("stream.chunk.rows"):
+            # streaming/multi-process path (the reference ran this Tool
+            # across N machines — MarkovStateTransitionModel.java:60);
+            # transition counts are exact ints, so the end-of-stream merge
+            # is order-free. Stable codes need a declared vocabulary.
+            if enc is None:
+                from avenir_tpu.core.config import ConfigError
+                raise ConfigError(
+                    "stream.chunk.rows on MarkovStateTransitionModel "
+                    "requires model.states (a chunked stream cannot "
+                    "discover a stable state vocabulary)")
+            model = _fit_streaming(
+                self, conf, input_path, counters,
+                lambda chunks, acc: chain.fit_chunks(chunks, enc,
+                                                     accumulator=acc)[0],
+                delim, skip)
+        else:
+            seqs = _sequences(input_path, delim, skip)
+            model, enc = chain.fit(seqs, encoder=enc)
+            counters.set("Records", "Processed", len(seqs))
+        if model is not None and self.is_output_writer():
+            write_output(output_path, model.to_lines(delim=conf.field_delim))
+
 
 
 class HiddenMarkovModelBuilder(Job):
@@ -71,24 +130,49 @@ class HiddenMarkovModelBuilder(Job):
         delim = conf.field_delim_regex
         sub = conf.get("sub.field.delim", ":")
         skip = conf.get_int("skip.field.count", 1)
-        seqs = _sequences(input_path, delim, skip)
         builder = mk.HMMBuilder(mesh=self.auto_mesh(conf), laplace=conf.get_float("laplace.smoothing", 1.0))
         states = conf.get_list("model.states")
         obs_vocab = conf.get_list("model.observations")
         obs_enc = mk.SequenceEncoder(obs_vocab) if obs_vocab else None
-        if conf.get_bool("partially.tagged", False):
-            if not states:
-                raise ValueError("partially.tagged mode requires model.states")
-            window = conf.get_float_list("window.function", [1.0, 0.75, 0.5, 0.25])
-            model = builder.fit_partially_tagged(
-                seqs, states, window_function=window, obs_encoder=obs_enc)
+        partial = conf.get_bool("partially.tagged", False)
+        if partial and not states:
+            raise ValueError("partially.tagged mode requires model.states")
+        window = conf.get_float_list("window.function", [1.0, 0.75, 0.5, 0.25])
+        if conf.get("stream.chunk.rows"):
+            # streaming/multi-process path (HiddenMarkovModelBuilder.java
+            # ran across N machines like every Tool); needs declared
+            # vocabularies for chunk-order-independent codes
+            if not states or obs_enc is None:
+                from avenir_tpu.core.config import ConfigError
+                raise ConfigError(
+                    "stream.chunk.rows on HiddenMarkovModelBuilder requires "
+                    "model.states and model.observations (a chunked stream "
+                    "cannot discover stable vocabularies)")
+            st_enc = mk.SequenceEncoder(states)
+            if partial:
+                fit = lambda chunks, acc: builder.fit_partially_tagged_chunks(
+                    chunks, states, obs_enc, window_function=window,
+                    accumulator=acc)
+            else:
+                fit = lambda chunks, acc: builder.fit_tagged_chunks(
+                    (([[tuple(t.split(sub, 1)) for t in seq] for seq in ck])
+                     for ck in chunks),
+                    st_enc, obs_enc, accumulator=acc)
+            model = _fit_streaming(self, conf, input_path, counters, fit,
+                                   delim, skip)
         else:
-            tagged = [[tuple(t.split(sub, 1)) for t in seq] for seq in seqs]
-            st_enc = mk.SequenceEncoder(states) if states else None
-            model = builder.fit_tagged(tagged, state_encoder=st_enc,
-                                       obs_encoder=obs_enc)
-        write_output(output_path, model.to_lines(delim=conf.field_delim))
-        counters.set("Records", "Processed", len(seqs))
+            seqs = _sequences(input_path, delim, skip)
+            if partial:
+                model = builder.fit_partially_tagged(
+                    seqs, states, window_function=window, obs_encoder=obs_enc)
+            else:
+                tagged = [[tuple(t.split(sub, 1)) for t in seq] for seq in seqs]
+                st_enc = mk.SequenceEncoder(states) if states else None
+                model = builder.fit_tagged(tagged, state_encoder=st_enc,
+                                           obs_encoder=obs_enc)
+            counters.set("Records", "Processed", len(seqs))
+        if model is not None and self.is_output_writer():
+            write_output(output_path, model.to_lines(delim=conf.field_delim))
 
 
 class ViterbiStatePredictor(Job):

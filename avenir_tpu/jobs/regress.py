@@ -32,20 +32,33 @@ class LogisticRegressionJob(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        _enc, ds, _rows = self.encode_input(conf, input_path, need_rows=False)
-        x = mlr.design_matrix(ds)
-        y = np.asarray(ds.labels, np.float32)
+        import contextlib
+
         coeff_path = conf.get("coeff.file.path") or os.path.join(
             output_path, "coefficients.txt")
+        est = mlr.LogisticRegression(
+            learning_rate=conf.get_float("learning.rate", 0.5),
+            max_iterations=conf.get_int("iteration.limit", 200),
+            convergence=conf.get("convergence.criteria", "average"),
+            threshold_pct=conf.get_float("convergence.threshold", 0.5),
+            l2=conf.get_float("l2.weight", 0.0),
+            mesh=self.auto_mesh(conf),
+        )
         # the coefficient-history rewrite is the reference's one cross-task
         # mutable-state hazard (LogisticRegressionJob.java:238-255, safe
         # there only via num.reducer=1): hold an exclusive lock for the
         # whole read-resume-train-rewrite cycle so a concurrent run is
         # detected (LockHeldError) instead of silently interleaving, and
-        # replace the file atomically so readers never see a torn history
+        # replace the file atomically so readers never see a torn history.
+        # Under jax.distributed only process 0 (the writer) takes the lock;
+        # peers read the resume history without it — a peer's run() is only
+        # reachable through the same distributed launch, not a concurrent
+        # independent job.
         os.makedirs(os.path.dirname(coeff_path) or ".", exist_ok=True)
-        with FileLock(coeff_path,
-                      timeout_s=conf.get_float("coeff.lock.timeout.sec", 10.0)):
+        lock = (FileLock(coeff_path,
+                         timeout_s=conf.get_float("coeff.lock.timeout.sec", 10.0))
+                if self.is_output_writer() else contextlib.nullcontext())
+        with lock:
             resume = None
             if os.path.exists(coeff_path):
                 with open(coeff_path) as fh:
@@ -53,23 +66,61 @@ class LogisticRegressionJob(Job):
                 if lines:
                     resume = mlr.LogisticRegressionModel.from_history_lines(
                         lines, delim=conf.field_delim)
-            est = mlr.LogisticRegression(
-                learning_rate=conf.get_float("learning.rate", 0.5),
-                max_iterations=conf.get_int("iteration.limit", 200),
-                convergence=conf.get("convergence.criteria", "average"),
-                threshold_pct=conf.get_float("convergence.threshold", 0.5),
-                l2=conf.get_float("l2.weight", 0.0),
-                mesh=self.auto_mesh(conf),
-            )
-            model = est.fit(x, y, resume_from=resume)
+            if conf.get("stream.chunk.rows"):
+                model, n_rows = self._fit_streaming(conf, input_path,
+                                                    counters, est, resume)
+            else:
+                _enc, ds, _rows = self.encode_input(conf, input_path,
+                                                    need_rows=False)
+                x = mlr.design_matrix(ds)
+                y = np.asarray(ds.labels, np.float32)
+                model = est.fit(x, y, resume_from=resume)
+                n_rows = ds.num_rows
             hist = model.history_lines(delim=conf.field_delim)
-            with atomic_write(coeff_path) as fh:
-                fh.write("\n".join(hist) + "\n")
+            if self.is_output_writer():
+                with atomic_write(coeff_path) as fh:
+                    fh.write("\n".join(hist) + "\n")
         status = "converged" if model.converged else "iterationLimit"
-        write_output(output_path, hist + [f"status{conf.field_delim}{status}"])
-        counters.set("Records", "Processed", ds.num_rows)
+        if self.is_output_writer():
+            write_output(output_path,
+                         hist + [f"status{conf.field_delim}{status}"])
+        counters.set("Records", "Processed", n_rows)
         counters.set("Iterations", "Run", model.iterations)
         counters.set("Iterations", "Converged", int(model.converged))
+
+    def _fit_streaming(self, conf: JobConfig, input_path: str,
+                       counters: Counters, est, resume):
+        """Streaming/multi-process LR: owned chunks are encoded into
+        design-matrix blocks kept device-resident across iterations; each
+        iteration folds per-chunk gradient partials across processes in
+        global chunk order (byte-identical for any nprocs — see
+        ``LogisticRegression.fit_chunked``).  The Hadoop analog is the
+        per-iteration MR job whose mappers each emitted one partial
+        gradient (LogisticRegressionJob.java:169-176,279-289)."""
+        if conf.get("stream.checkpoint.dir"):
+            from avenir_tpu.core.config import ConfigError
+            raise ConfigError(
+                "stream.checkpoint.dir does not apply to "
+                "LogisticRegressionJob: the coefficient history file IS "
+                "the checkpoint (every completed iteration is durable and a "
+                "re-run resumes from its last row, "
+                "LogisticRegressionJob.java:238-255) — unset the key")
+        owner, _acc, distributed = self.distributed_plan(conf, None)
+        enc = self.encoder_for(conf)
+        chunks = []
+        for ds, cur in self.iter_encoded_retrying(
+                conf, input_path, enc, counters, emit_cursor=True,
+                owner=owner):
+            chunks.append((cur["chunk"] - 1, mlr.design_matrix(ds),
+                           np.asarray(ds.labels, np.float32)))
+        merge = None
+        if distributed:
+            from avenir_tpu.parallel.mesh import all_process_sum_state
+            merge = all_process_sum_state
+        model = est.fit_chunked(chunks, resume_from=resume, merge=merge)
+        # fit_chunked's handshake already folded the global row count —
+        # n_rows rides on the model, no second collective needed
+        return model, model.n_rows
 
 
 class FisherDiscriminant(Job):

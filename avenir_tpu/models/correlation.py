@@ -73,7 +73,12 @@ class CategoricalCorrelation:
         dst: Optional[Sequence[int]] = None,
         against_class: bool = False,
         feature_names: Optional[Sequence[str]] = None,
+        accumulator=None,
     ) -> CorrelationResult:
+        """``accumulator``: an externally-owned accumulator (the
+        multi-process jobs path injects one whose totals are merged across
+        processes at end of stream — all counts here are exact integers, so
+        the merge is order-free); by default a private one is used."""
         meta, chunks = peek_chunks(data)           # lazy: stream-friendly
         f, b = meta.num_binned, meta.max_bins
         names = list(feature_names) if feature_names is not None else [
@@ -90,7 +95,7 @@ class CategoricalCorrelation:
             pairs = [(i, j) for i in src_idx for j in dst_idx if i < j]
             pair_names = [(names[i], names[j]) for i, j in pairs]
         b_dst = max(b, meta.num_classes) if against_class else b
-        acc = agg.Accumulator()
+        acc = accumulator if accumulator is not None else agg.Accumulator()
         from avenir_tpu.parallel.mesh import maybe_shard_batch
 
         # single-TPU fast path: feature-pair contingency tables are exactly
@@ -102,12 +107,29 @@ class CategoricalCorrelation:
         from avenir_tpu.ops import pallas_hist
         n_cls = meta.num_classes if against_class else 1
         fast = pallas_hist.use_kernel(f, b, n_cls, mesh=self.mesh)
+        # layout-qualified kernel key + stale-path rejection (mirrors
+        # mutual_info.py's resume gate): a checkpoint written on the OTHER
+        # count path (or another kernel layout) must fail loudly — silently
+        # preferring one key family would discard every chunk accumulated
+        # under the other (pre- or post-resume) and corrupt the statistics
+        gk = pallas_hist.g_key(f, b, n_cls) if fast else None
+        if accumulator is not None:
+            expected = {gk} if fast else {
+                f"c{s}" for s in range(0, len(pairs), self.pair_chunk)}
+            stale = [k for k in accumulator.names() if k not in expected]
+            if stale:
+                raise ValueError(
+                    f"restored correlation accumulator holds keys {stale} "
+                    f"incompatible with this run's count path "
+                    f"({'kernel ' + gk if fast else 'einsum'}); the snapshot "
+                    f"was written under a different device/kernel layout — "
+                    f"clear the checkpoint directory and re-run")
         for ds in chunks:
             codes, lab = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             if fast:
                 y = lab if against_class else jnp.zeros(codes.shape[0],
                                                         jnp.int32)
-                acc.add("g", pallas_hist.cooc_counts(codes, y, b, n_cls))
+                acc.add(gk, pallas_hist.cooc_counts(codes, y, b, n_cls))
                 continue
             for s in range(0, len(pairs), self.pair_chunk):
                 sl = pairs[s:s + self.pair_chunk]
@@ -119,15 +141,15 @@ class CategoricalCorrelation:
                 else:
                     cj = codes[:, [p[1] for p in sl]]
                 acc.add(f"c{s}", agg.pair_counts(ci, cj, b_dst))
-        if "g" in acc and against_class:
+        if fast and gk in acc and against_class:
             fbc, _ = pallas_hist.counts_from_cooc(
-                acc.get("g"), f, b, n_cls, np.zeros(0, np.int64),
+                acc.get(gk), f, b, n_cls, np.zeros(0, np.int64),
                 np.zeros(0, np.int64))                   # [F, B, C]
             cont = np.zeros((len(pairs), b_dst, b_dst), fbc.dtype)
             cont[:, :b, :n_cls] = fbc[src_idx]
-        elif "g" in acc:
+        elif fast and gk in acc:
             _, pair4 = pallas_hist.counts_from_cooc(
-                acc.get("g"), f, b, 1,
+                acc.get(gk), f, b, 1,
                 np.array([p[0] for p in pairs], np.int64),
                 np.array([p[1] for p in pairs], np.int64))
             cont = pair4[:, :, :, 0]                     # [P, B, B]

@@ -64,6 +64,15 @@ def _grad_step(w: jax.Array, x: jax.Array, y: jax.Array, n: jax.Array,
     return w + lr * grad
 
 
+@jax.jit
+def _chunk_grad(w: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+    """One chunk's UNSCALED gradient partial Σ x·(y−σ(wᵀx)) — the exact
+    quantity a reference mapper emitted in cleanup
+    (LogisticRegressionJob.java:169-176 via LogisticRegressor.java:61-73)."""
+    p = jax.nn.sigmoid(x @ w)
+    return x.T @ (y - p)
+
+
 def _converged(prev: np.ndarray, cur: np.ndarray, criterion: str,
                threshold_pct: float) -> bool:
     """Relative per-coefficient change in percent (LogisticRegressor.java:105-163):
@@ -83,6 +92,7 @@ class LogisticRegressionModel:
     history: List[np.ndarray] = dc_field(default_factory=list)   # per-iteration coeffs
     converged: bool = False
     iterations: int = 0
+    n_rows: int = 0                          # global rows fit saw (chunked path)
 
     # -- coefficient-history serde (the reference's coeff file contract) -----
     def history_lines(self, delim: str = ",") -> List[str]:
@@ -150,6 +160,75 @@ class LogisticRegression:
             w = w_new
         return LogisticRegressionModel(weights=np.asarray(w), history=history,
                                        converged=converged, iterations=len(history))
+
+    def fit_chunked(self, chunks, resume_from: Optional[LogisticRegressionModel] = None,
+                    merge=None) -> LogisticRegressionModel:
+        """Streaming/multi-process fit over pre-encoded design-matrix chunks.
+
+        ``chunks``: list of ``(global_chunk_index, x [n_c, D] f32, y [n_c])``
+        — under jax.distributed each process passes only its OWNED chunks
+        (round-robin by index, the analog of the reference's per-mapper
+        gradient partials, LogisticRegressionJob.java:169-176).  ``merge``:
+        callable folding a ``{key: array}`` state across processes
+        (``parallel.mesh.all_process_sum_state``); None = single-process.
+
+        Byte-identical across process counts BY CONSTRUCTION: each chunk's
+        gradient partial is computed on device in f32 (shape-identical work
+        regardless of which process runs it), fetched to host f64, and the
+        global gradient is summed in GLOBAL CHUNK ORDER — so the f64
+        addition sequence, the weight update, and the convergence decisions
+        are identical for any nprocs.  Every process must call this with
+        the same iteration config: the per-iteration merge is a collective.
+
+        The weight vector lives in host f64 (the reducer role); the per-
+        chunk matvec runs in f32 on device (the mapper role) — mirroring
+        the reference's mapper/reducer numerics split (float map-side
+        accumulation, exact reduce-side fold)."""
+        merge = merge if merge is not None else (
+            lambda s: {k: np.asarray(v) for k, v in s.items()})
+        local_n = sum(x.shape[0] for _, x, _ in chunks)
+        local_d = max((x.shape[1] for _, x, _ in chunks), default=0)
+        hand = merge({"n": np.array([local_n], np.int64),
+                      "max:d": np.array([local_d], np.int64)})
+        n_total = int(hand["n"][0])
+        d = int(hand["max:d"][0])
+        if n_total == 0:
+            from avenir_tpu.core.encoding import NoDataError
+            raise NoDataError("no data")
+        for _, x, _ in chunks:
+            if x.shape[1] != d:
+                raise ValueError(
+                    f"chunk design width {x.shape[1]} != global width {d} — "
+                    "schema mismatch across chunks/processes")
+        dev = [(idx, jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32))
+               for idx, x, y in chunks]
+        if resume_from is not None:
+            w = np.asarray(resume_from.weights, np.float64)
+            history = list(resume_from.history)
+        else:
+            w = np.zeros(d, np.float64)
+            history = []
+        converged = False
+        for _ in range(self.max_iterations):
+            wf = jnp.asarray(w, jnp.float32)
+            state = {f"g{idx:08d}": np.asarray(_chunk_grad(wf, xd, yd),
+                                               np.float64)
+                     for idx, xd, yd in dev}
+            tot = merge(state)
+            grad = np.zeros(d, np.float64)
+            for k in sorted(tot):                    # global chunk order
+                grad = grad + tot[k]
+            w = w + self.learning_rate * (grad / n_total - self.l2 * w)
+            history.append(w.copy())
+            if len(history) >= 2 and _converged(history[-2], history[-1],
+                                                self.convergence,
+                                                self.threshold_pct):
+                converged = True
+                break
+        return LogisticRegressionModel(weights=w.copy(), history=history,
+                                       converged=converged,
+                                       iterations=len(history),
+                                       n_rows=n_total)
 
     @staticmethod
     def predict_proba(model: LogisticRegressionModel, x: np.ndarray) -> np.ndarray:

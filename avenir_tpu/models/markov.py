@@ -140,14 +140,46 @@ class MarkovChain:
     def fit(self, seqs: Sequence[Sequence[str]],
             encoder: Optional[SequenceEncoder] = None) -> Tuple[MarkovChainModel, SequenceEncoder]:
         enc = encoder if encoder is not None else SequenceEncoder().fit(seqs)
-        codes, _ = enc.encode(seqs)
-        s = len(enc)
+        acc = agg.Accumulator()
+        self.accumulate(seqs, enc, acc)
+        return self.finalize(enc, acc), enc
+
+    def accumulate(self, seqs: Sequence[Sequence[str]],
+                   encoder: SequenceEncoder, acc) -> None:
+        """Fold one batch of sequences into ``acc["trans"]`` (exact int64)."""
+        codes, _ = encoder.encode(seqs)
+        s = len(encoder)
         a, b = adjacent_pairs(codes)
         from avenir_tpu.parallel.mesh import maybe_shard_batch
         a_b, b_b = maybe_shard_batch(self.mesh, a, b)   # -1 pads count-neutral
-        counts = np.asarray(agg.transition_counts(a_b, b_b, s, s), np.float64)
-        return MarkovChainModel(states=list(enc.symbols), counts=counts,
-                                laplace=self.laplace, scale=self.scale), enc
+        acc.add("trans", agg.transition_counts(a_b, b_b, s, s))
+
+    def finalize(self, encoder: SequenceEncoder, acc) -> MarkovChainModel:
+        counts = np.asarray(acc.get("trans"), np.float64)
+        return MarkovChainModel(states=list(encoder.symbols), counts=counts,
+                                laplace=self.laplace, scale=self.scale)
+
+    def fit_chunks(self, chunks: Iterable[Sequence[Sequence[str]]],
+                   encoder: SequenceEncoder,
+                   accumulator=None) -> Tuple[MarkovChainModel, SequenceEncoder]:
+        """Streaming fit over an iterable of sequence batches.
+
+        Requires a pre-built ``encoder`` (``model.states``): with chunked
+        input, codes must be stable before the first chunk — vocabulary
+        discovery would assign chunk-order-dependent codes.  ``accumulator``
+        may be externally owned (multi-process jobs inject one whose totals
+        are merged across processes when the stream exhausts; transition
+        counts are exact integers, so the merge is order-free).  Raises
+        :class:`~avenir_tpu.core.encoding.NoDataError` when no process
+        contributed any sequence — after the merge collective, matching
+        ``Job.distributed_fit``'s zero-chunk tolerance."""
+        acc = accumulator if accumulator is not None else agg.Accumulator()
+        for seqs in chunks:
+            self.accumulate(seqs, encoder, acc)
+        if "trans" not in acc:
+            from avenir_tpu.core.encoding import NoDataError
+            raise NoDataError("no data")
+        return self.finalize(encoder, acc), encoder
 
 
 # ---------------------------------------------------------------------------
@@ -203,25 +235,59 @@ class HMMBuilder:
         (HiddenMarkovModelBuilder.java:136-166)."""
         st_enc = state_encoder or SequenceEncoder().fit([[s for _, s in seq] for seq in seqs])
         ob_enc = obs_encoder or SequenceEncoder().fit([[o for o, _ in seq] for seq in seqs])
+        acc = agg.Accumulator()
+        self.accumulate_tagged(seqs, st_enc, ob_enc, acc)
+        return self.finalize(st_enc, ob_enc, acc)
+
+    def accumulate_tagged(self, seqs, st_enc: SequenceEncoder,
+                          ob_enc: SequenceEncoder, acc) -> None:
+        """Fold one batch of tagged sequences into ``acc`` (keys ``init``,
+        ``trans``, ``emit`` — all exact int64 counts)."""
         st_codes, _ = st_enc.encode([[s for _, s in seq] for seq in seqs])
         ob_codes, _ = ob_enc.encode([[o for o, _ in seq] for seq in seqs])
         s, o = len(st_enc), len(ob_enc)
+        if not st_codes.size:
+            return
         # initial states
-        init = np.bincount(st_codes[:, 0][st_codes[:, 0] >= 0], minlength=s).astype(np.float64)
+        acc.add("init", np.bincount(st_codes[:, 0][st_codes[:, 0] >= 0],
+                                    minlength=s).astype(np.int64))
         from avenir_tpu.parallel.mesh import maybe_shard_batch
         # transitions (−1 pads are count-neutral under one-hot)
         a_src, a_dst = maybe_shard_batch(self.mesh, *adjacent_pairs(st_codes))
-        trans = np.asarray(agg.transition_counts(a_src, a_dst, s, s),
-                           np.float64)
+        acc.add("trans", agg.transition_counts(a_src, a_dst, s, s))
         # emissions: state/obs pairs at the same position
         valid = (st_codes >= 0) & (ob_codes >= 0)
         st_flat, ob_flat = maybe_shard_batch(
             self.mesh,
             np.where(valid, st_codes, -1).ravel(),
             np.where(valid, ob_codes, -1).ravel())
-        emit = np.asarray(agg.transition_counts(st_flat, ob_flat, s, o),
-                          np.float64)
-        return self._normalize(st_enc, ob_enc, trans, emit, init)
+        acc.add("emit", agg.transition_counts(st_flat, ob_flat, s, o))
+
+    def finalize(self, st_enc: SequenceEncoder, ob_enc: SequenceEncoder,
+                 acc) -> HMMModel:
+        s, o = len(st_enc), len(ob_enc)
+        get = lambda k, shape: (np.asarray(acc.get(k), np.float64)
+                                if k in acc else np.zeros(shape))
+        return self._normalize(st_enc, ob_enc, get("trans", (s, s)),
+                               get("emit", (s, o)), get("init", (s,)))
+
+    def fit_tagged_chunks(self, chunks, state_encoder: SequenceEncoder,
+                          obs_encoder: SequenceEncoder,
+                          accumulator=None) -> HMMModel:
+        """Streaming fully-tagged fit over an iterable of sequence batches;
+        both encoders must be pre-built (``model.states`` /
+        ``model.observations``) for chunk-order-independent codes.  All
+        counts are exact integers, so a multi-process merge of the
+        injected ``accumulator`` is order-free.  Raises ``NoDataError``
+        when no process contributed anything (after the merge collective,
+        mirroring :meth:`MarkovChain.fit_chunks`)."""
+        acc = accumulator if accumulator is not None else agg.Accumulator()
+        for seqs in chunks:
+            self.accumulate_tagged(seqs, state_encoder, obs_encoder, acc)
+        if "trans" not in acc:
+            from avenir_tpu.core.encoding import NoDataError
+            raise NoDataError("no data")
+        return self.finalize(state_encoder, obs_encoder, acc)
 
     def fit_partially_tagged(
         self,
@@ -240,9 +306,39 @@ class HMMBuilder:
         st_enc = SequenceEncoder(list(states))
         ob_enc = obs_encoder or SequenceEncoder().fit(
             [[t for t in seq if t not in state_set] for seq in token_seqs])
+        acc = agg.Accumulator()
+        self.accumulate_partial(token_seqs, st_enc, ob_enc, window_function,
+                                acc)
+        return self.finalize(st_enc, ob_enc, acc)
+
+    def fit_partially_tagged_chunks(self, chunks, states: Sequence[str],
+                                    obs_encoder: SequenceEncoder,
+                                    window_function: Sequence[float] = (1.0, 0.75, 0.5, 0.25),
+                                    accumulator=None) -> HMMModel:
+        """Streaming partially-tagged fit; ``obs_encoder`` must be pre-built
+        (``model.observations``).  ``init``/``trans`` counts are exact
+        integers; ``emit`` sums window weights in float64 — with the
+        default dyadic window (1, .75, .5, .25) those sums are exact too,
+        so a multi-process merge stays byte-identical; non-dyadic custom
+        windows may differ from a single-process run in the last ulp."""
+        st_enc = SequenceEncoder(list(states))
+        acc = accumulator if accumulator is not None else agg.Accumulator()
+        for seqs in chunks:
+            self.accumulate_partial(seqs, st_enc, obs_encoder,
+                                    window_function, acc)
+        if "init" not in acc:
+            from avenir_tpu.core.encoding import NoDataError
+            raise NoDataError("no data")
+        return self.finalize(st_enc, obs_encoder, acc)
+
+    def accumulate_partial(self, token_seqs, st_enc: SequenceEncoder,
+                           ob_enc: SequenceEncoder,
+                           window_function: Sequence[float], acc) -> None:
+        """Fold one batch of partially-tagged sequences into ``acc``."""
+        state_set = set(st_enc.symbols)
         s, o = len(st_enc), len(ob_enc)
-        init = np.zeros(s)
-        trans = np.zeros((s, s))
+        init = np.zeros(s, np.int64)
+        trans = np.zeros((s, s), np.int64)
         st_list: List[int] = []
         ob_list: List[int] = []
         w_list: List[float] = []
@@ -305,7 +401,9 @@ class HMMBuilder:
                     w_all[s0:s0 + step])
                 emit += np.asarray(agg.weighted_transition_counts(
                     st_b, ob_b, w_b, s, o), np.float64)
-        return self._normalize(st_enc, ob_enc, trans, emit, init)
+        acc.add("init", init)
+        acc.add("trans", trans)
+        acc.add("emit", emit)
 
     def _normalize(self, st_enc, ob_enc, trans, emit, init) -> HMMModel:
         lam = self.laplace

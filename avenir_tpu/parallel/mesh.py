@@ -274,7 +274,13 @@ def all_process_sum_state(state: dict) -> dict:
     default x64-off config.  Per-key sums run on host in ascending
     process order — the fixed order keeps float accumulation
     deterministic, and integer counts are exact in any order, so
-    distributed output files stay reproducible."""
+    distributed output files stay reproducible.
+
+    Keys prefixed ``min:`` / ``max:`` merge by elementwise minimum /
+    maximum instead of summing (order-free and exact for any dtype) —
+    the analog of a Hadoop reducer folding MIN/MAX aggregates; used for
+    extrema stats and for broadcasting a dimension only some processes
+    know (``max:`` over 0/D)."""
     if jax.process_count() == 1:
         return {k: np.asarray(v) for k, v in state.items()}
     import json as _json
@@ -316,7 +322,12 @@ def all_process_sum_state(state: dict) -> dict:
                         f"process {p} contributed {key!r} with shape "
                         f"{arr.shape}, expected {out[key].shape} — schema "
                         f"mismatch across processes")
-                out[key] = out[key] + arr
+                if key.startswith("min:"):
+                    out[key] = np.minimum(out[key], arr)
+                elif key.startswith("max:"):
+                    out[key] = np.maximum(out[key], arr)
+                else:
+                    out[key] = out[key] + arr
             else:
                 out[key] = arr.copy()
     return out

@@ -12,20 +12,29 @@ the headline measurement:
 
 - ``matmul_4096_bf16_ms`` — a chained 4096x4096x4096 bf16 matmul
   (137 GFLOP/call).  Pure MXU + HBM; no custom kernels, no framework
-  code — if this is slow, the rig is slow.  The healthy band is
-  established empirically by the artifacts that carry the field (round-2
-  notes measured ~6.5 ms through the tunnel).
+  code — if this is slow, the rig is slow.
 - ``knn_dot_ms`` (kNN artifacts only) — the bare distance dot at the kNN
-  serving shape ([batch, 128] x [1M, 128]^T bf16), the measured lower
-  bound the fused search kernel is judged against
+  serving shape ([batch, 128] x [1M, 128]^T bf16 with a running row max),
+  the measured lower bound the fused search kernel is judged against
   (docs/architecture.md "ceilings").  If headline QPS drops while this
   stays put, the kernel (or its memory layout) regressed; if both drop by
   the same factor, the rig did.
 
-Timing uses the chained-dispatch discipline: ``jax.block_until_ready`` is
-a no-op on the tunnel transport, so each call feeds a reduced scalar of
-the previous result into its operand and one host fetch at the end
-barriers the whole chain.
+Timing methodology (this rig forces all three):
+
+1. ``jax.block_until_ready`` is a no-op on the tunnel transport — only a
+   host fetch is a barrier.
+2. A synced fetch costs ~100 ms RTT, so the probe chains N dispatches and
+   fetches once.
+3. Each probe step is ONE jitted call returning a 0-d carry (the scalar
+   chains into the next call's operand), because per-op eager dispatch
+   overhead through the tunnel is large and variable — the first version
+   of this module chained eager ``ravel()[0]`` extractions and measured
+   167 ms for the 4096³ matmul while the fused kNN kernel simultaneously
+   ran at full speed (round-5 probe log).
+4. The constant overhead (final fetch + warmup jitter) is removed by a
+   two-point slope: time chains of ``reps_lo`` and ``reps_hi`` calls and
+   report ``(t_hi - t_lo) / (reps_hi - reps_lo)``.
 """
 
 from __future__ import annotations
@@ -38,40 +47,51 @@ import jax
 import jax.numpy as jnp
 
 
-def _chained_ms(step, operand, reps: int) -> float:
-    """Per-call ms of ``step(operand + bias)`` over a dependency chain.
+def _slope_ms(step_scalar, operand, reps_lo: int = 2, reps_hi: int = 10) -> float:
+    """Per-call ms of ``step_scalar(operand, carry) -> 0-d carry`` via the
+    two-point chained-dispatch slope (see module doc)."""
+    def run(n: int) -> float:
+        carry = jnp.zeros((), jnp.float32)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            carry = step_scalar(operand, carry)
+        np.asarray(jax.device_get(carry))
+        return time.perf_counter() - t0
 
-    ``step`` must return an array; a scalar of call i's result biases call
-    i+1's operand so the final host fetch waits for every call."""
-    bias = jnp.zeros((), operand.dtype)
-    out = step(operand + bias)                  # compile + warm
-    np.asarray(jax.device_get(out.ravel()[0]))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = step(operand + bias)
-        bias = (out.ravel()[0] * 0).astype(operand.dtype)
-    np.asarray(jax.device_get(out.ravel()[0]))
-    return (time.perf_counter() - t0) * 1e3 / reps
+    run(2)                                   # compile + warm
+    t_lo = run(reps_lo)
+    t_hi = run(reps_hi)
+    return max((t_hi - t_lo) * 1e3 / (reps_hi - reps_lo), 0.0)
 
 
-def matmul_canary_ms(dim: int = 4096, reps: int = 4) -> float:
+def matmul_canary_ms(dim: int = 4096, reps: int = 8) -> float:
     """Chained ``dim³`` bf16 matmul, per-call ms (2·dim³ FLOPs/call)."""
     a = jnp.asarray(np.random.default_rng(0).normal(
         size=(dim, dim)).astype(np.float32)).astype(jnp.bfloat16)
-    step = jax.jit(lambda x: jnp.dot(x, a, preferred_element_type=jnp.float32)
-                   .astype(jnp.bfloat16))
-    return _chained_ms(step, a, reps)
+
+    @jax.jit
+    def step(x, carry):
+        out = jnp.dot(x + carry.astype(jnp.bfloat16), a,
+                      preferred_element_type=jnp.float32)
+        # data-dependent 0-d carry, scaled so the chained perturbation is
+        # far below bf16 resolution (never constant-foldable, never drifts)
+        return out[0, 0] * jnp.float32(1e-30)
+
+    return _slope_ms(step, a, reps_lo=2, reps_hi=2 + reps)
 
 
 def knn_dot_canary_ms(batch: int = 16384, n_refs: int = 1_000_000,
-                      width: int = 128, reps: int = 3,
+                      width: int = 128, reps: int = 4,
                       refs=None) -> float:
     """Chained bare distance dot at the kNN serving shape, per-call ms.
 
     ``refs`` may pass an existing device-resident [n_refs, width] bf16
     operand (e.g. the actual packed reference matrix) so the canary times
     the dot against the very buffer the kernel reads; by default it
-    uploads a fresh one.
+    uploads a fresh one.  The dot streams reference tiles under a
+    ``lax.scan`` with a running row max — the monolithic [batch, n_refs]
+    f32 output would be ~65 GB at the serving shape (XLA:TPU does not
+    fuse a reduce into a matmul).
     """
     rng = np.random.default_rng(0)
     if refs is None:
@@ -79,21 +99,20 @@ def knn_dot_canary_ms(batch: int = 16384, n_refs: int = 1_000_000,
                            .astype(np.float32)).astype(jnp.bfloat16)
     q = jnp.asarray(rng.normal(size=(batch, width))
                     .astype(np.float32)).astype(jnp.bfloat16)
-    # scan over reference tiles with a running max: the monolithic
-    # [batch, n_refs] f32 dot output would be ~65 GB at the serving shape
-    # (XLA:TPU does not fuse a reduce into a matmul) — one [batch, TILE]
-    # tile lives at a time (~1 GB), matching how the real kernel streams
     tile = 16384
     n = refs.shape[0] - refs.shape[0] % tile
     r_tiles = refs[:n].reshape(-1, tile, refs.shape[1])
 
-    def step_fn(x):
+    @jax.jit
+    def step(x, carry):
+        xq = x + carry.astype(x.dtype)
+
         def body(best, r):
-            d = jnp.dot(x, r.T, preferred_element_type=jnp.float32)
+            d = jnp.dot(xq, r.T, preferred_element_type=jnp.float32)
             return jnp.maximum(best, d.max(axis=1)), None
+
         init = jnp.full((x.shape[0],), -jnp.inf, jnp.float32)
         best, _ = jax.lax.scan(body, init, r_tiles)
-        return best
+        return best[0] * jnp.float32(1e-30)
 
-    step = jax.jit(step_fn)
-    return _chained_ms(step, q, reps)
+    return _slope_ms(step, q, reps_lo=1, reps_hi=1 + reps)

@@ -1,19 +1,29 @@
 """Worker for the multi-process JOB/CLI contract test (test_multiprocess.py).
 
 Each process owns 4 virtual CPU devices and joins a jax.distributed run,
-then executes the SAME `get_job(name).run(conf, in, out)` call a user would
-— the multi-host analog of `hadoop jar avenir.jar BayesianDistribution ...`
-fanning out over a cluster (BayesianDistribution.java:82).  Chunks are
-round-robin assigned by the job layer, per-process partial counts are
-merged at end of stream, and only process 0 writes the part file.
+then executes the SAME `get_job(name).run(conf, in, out)` calls a user would
+— the multi-host analog of `hadoop jar avenir.jar <Tool> ...` fanning out
+over a cluster (the reference ran EVERY Tool across N machines:
+BayesianDistribution.java:82, CramerCorrelation.java:83,
+MarkovStateTransitionModel.java:60, LogisticRegressionJob.java:279-289).
+Chunks are round-robin assigned by the job layer, per-process partials are
+merged at end of stream (or per iteration for LR), and only process 0
+writes the part file.
+
+The job list is read from ``<workdir>/jobs.json``:
+``[{"job": name, "input": path, "outdir": name, "conf": {...},
+    "expect_rows": N}, ...]`` — written by the test, which also runs the
+same specs single-process and compares output bytes.
 """
 
+import json
 import os
 import sys
 
 
 def main():
     port, pid, nprocs, workdir = sys.argv[1:5]
+    jobs_file = sys.argv[5] if len(sys.argv) > 5 else "jobs.json"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "").replace(
@@ -31,23 +41,35 @@ def main():
                            num_processes=int(nprocs), process_id=int(pid))
     assert jax.process_count() == int(nprocs)
 
-    # third case: one 3000-row chunk over 2 processes — process 1 owns ZERO
-    # chunks and must still complete (vacuous merge contribution, no write)
-    for job_name, outdir, chunk_rows in [
-            ("BayesianDistribution", "out_nb_mp", "250"),
-            ("MutualInformation", "out_mi_mp", "250"),
-            ("BayesianDistribution", "out_nb_1chunk", "3000")]:
+    specs = json.load(open(os.path.join(workdir, jobs_file)))
+    for spec in specs:
         conf = JobConfig()
-        conf.set("feature.schema.file.path", os.path.join(workdir, "schema.json"))
-        conf.set("stream.chunk.rows", chunk_rows)
-        c = get_job(job_name).run(conf, os.path.join(workdir, "train.csv"),
-                                  os.path.join(workdir, outdir))
+        for k, v in spec["conf"].items():
+            conf.set(k, str(v))
+        if spec.get("expect_crash"):
+            # fault-injection leg of the kill+resume proof: the injected
+            # crash must fire on every process (each at its own consumed-
+            # chunk count), leaving per-process snapshots behind
+            try:
+                get_job(spec["job"]).run(
+                    conf, os.path.join(workdir, spec["input"]),
+                    os.path.join(workdir, spec["outdir"]))
+            except RuntimeError as e:
+                assert "injected crash" in str(e), e
+                print(f"proc {idx} crashed as injected", flush=True)
+                continue
+            raise AssertionError("expected injected crash did not fire")
+        c = get_job(spec["job"]).run(
+            conf, os.path.join(workdir, spec["input"]),
+            os.path.join(workdir, spec["outdir"]))
         # merged counters must report the WHOLE input on every process
-        assert c.get("Records", "Processed") == 3000, c.get(
-            "Records", "Processed")
+        if "expect_rows" in spec:
+            got = c.get("Records", "Processed")
+            assert got == spec["expect_rows"], (spec["job"], got)
         if idx == 0:
-            part = os.path.join(workdir, outdir, "part-00000")
-            assert os.path.exists(part), "writer process produced no output"
+            part = os.path.join(workdir, spec["outdir"], "part-00000")
+            assert os.path.exists(part), \
+                f"writer produced no output for {spec['job']}"
     print(f"proc {idx} ok", flush=True)
 
 
