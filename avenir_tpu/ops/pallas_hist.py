@@ -36,6 +36,11 @@ Three expansion layouts, routed statically by :func:`plan`:
   handles any cardinality via lazily-sparse reducer maps,
   ``explore/MutualInformation.java:421-432``; here wide shapes
   previously fell silently to the 80-113M rows/s scatter einsum).
+- ``clsb`` (round 5, wider still: Wc up to MAX_W_CLSB, C up to
+  MAX_C_CLSB): the same per-class gram banded over G's rows — only a
+  [C, TR, Wp] accumulator band and one expansion block live in VMEM per
+  grid step, so e.g. 100 features × 20 bins × 2 classes (Wc=2048) stays
+  on the MXU two tiers past the einsum fallback.
 
 Round-4 bisection (TPU v5 lite, fresh process per variant, chained-
 dispatch host-fetch sync, 16M-row chunks, hosp_readmit shape F=11 B=12
@@ -101,6 +106,18 @@ MAX_W_CLS = 1536
 MAX_C_CLS = 8
 MAX_G_BYTES_CLS = 25 * 1024 * 1024
 
+# Blocked per-class mode ("clsb", round 5): same per-class gram math as
+# "cls", but G [C, wp, wp] lives in HBM and the kernel accumulates one
+# [C, TR, wp] ROW BAND per grid step — only the band (≤ the budget below),
+# the expansion block and the codes block occupy VMEM, so the per-class
+# width extends to MAX_W_CLSB.  The expansion is recomputed once per
+# (band, column-block); that costs ~3·wp·BN ops against the band's
+# 2·C·TR·wp·BN MAC dot — a ~3/(2·C·TR) ≈ 0.1% overhead, which is why
+# banding the OUTPUT (not re-tiling the input) is the right split.
+MAX_W_CLSB = 6144
+MAX_C_CLSB = 16
+_ACC_BYTES_CLSB = 35 * 1024 * 1024
+
 # column-block default for the fmaj (int8-only-VMEM) expand; the jmaj
 # fallback materializes an int32 [Wp, BN] block and scales down harder
 _DEFAULT_BN = 98304
@@ -133,7 +150,51 @@ def plan(num_feat: int, num_bins: int, num_classes: int):
     if (wcp <= MAX_W_CLS and 2 <= num_classes <= MAX_C_CLS
             and num_classes * wcp * wcp * 4 <= MAX_G_BYTES_CLS):
         return "cls", num_bins, wcp
+    tile = clsb_tile(num_feat, num_bins, num_classes)
+    if tile is not None:
+        return "clsb", num_bins, tile[1]
     return narrow          # too wide for any kernel; applicable() rejects
+
+
+def clsb_tile(num_feat: int, num_bins: int, num_classes: int):
+    """(row-band height TR, padded per-class width wp) for the blocked
+    per-class mode, or None when the shape is outside its gates.
+
+    A band is a WHOLE NUMBER OF BINS (TR = F·k): in the j-major layout
+    w = bin·F + f, a bin-aligned band's rows are ``code[i % F]`` compared
+    against ``r·k + i//F`` — constructible in-kernel from static concats
+    plus the scalar band offset (Mosaic has no dynamic_slice, so the band
+    CANNOT be sliced out of a full-width expansion).  k is the largest
+    power-of-2 scale with TR ≈ 512 whose [C, TR, wp] int32 accumulator
+    band fits the VMEM budget; wp pads the BIN count to a multiple of k
+    (pad bins select ``_PAD_SEL`` and stay exactly zero in G).  Pure
+    function of the shape — plan(), the kernel and the tests must all
+    derive the identical tiling."""
+    wcp = _ru(num_feat * num_bins, 128)
+    if not (MAX_W_CLS < wcp or num_classes > MAX_C_CLS
+            or num_classes * wcp * wcp * 4 > MAX_G_BYTES_CLS):
+        return None                      # plain cls mode serves it
+    if wcp > MAX_W_CLSB or not 2 <= num_classes <= MAX_C_CLSB:
+        return None
+    import math
+
+    # Mosaic block rule: the band (second-to-last out dim) must be
+    # divisible by 8 — so k must be a multiple of 8/gcd(F, 8).  Among the
+    # VMEM-feasible k, prefer the one minimizing the padded width (bin
+    # padding inflates the dot work quadratically), then the largest k
+    # (fewer bands → less expansion recompute).
+    m = 8 // math.gcd(num_feat, 8)
+    kmax = _ru(max(512 // num_feat, 1), m) + m
+    best = None
+    for k in range(m, kmax + 1, m):
+        tr = num_feat * k
+        wp = num_feat * _ru(num_bins, k)
+        if wp > MAX_W_CLSB or num_classes * tr * wp * 4 > _ACC_BYTES_CLSB:
+            continue
+        key = (wp, -k)
+        if best is None or key < best[0]:
+            best = (key, (tr, wp))
+    return best[1] if best else None
 
 
 def g_key(num_feat: int, num_bins: int, num_classes: int) -> str:
@@ -155,7 +216,7 @@ def w_index(num_feat: int, num_bins: int, num_classes: int) -> np.ndarray:
     In ``cls`` mode the index is within class c's [wp, wp] gram (G is
     [C, wp, wp]); it is the same for every c."""
     mode, jcp, _ = plan(num_feat, num_bins, num_classes)
-    if mode == "cls":
+    if mode in ("cls", "clsb"):
         w2 = np.arange(num_bins)[None, :] * num_feat \
             + np.arange(num_feat)[:, None]
         return np.repeat(w2[:, :, None], num_classes, axis=2).astype(np.int64)
@@ -176,6 +237,10 @@ def default_block_cols(wp: int, mode: str = "fmaj") -> int:
         bn = min(_DEFAULT_BN, (72 * 1024 * 1024) // max(wp, 128))
     elif mode == "cls":
         bn = min(49152, (64 * 1024 * 1024) // (5 * max(wp, 128)))
+    elif mode == "clsb":
+        # int32 jrept (4 B) + bool hit + int8 xt ≈ 6 B per (w, col) cell,
+        # beside the [C, TR, wp] band the budget in clsb_tile reserves
+        bn = (50 * 1024 * 1024) // (6 * max(wp, 128))
     else:
         bn = 49152 * 384 // max(wp, 128)
     return max(128, (bn // 128) * 128)
@@ -263,6 +328,52 @@ def _cooc_cls_kernel(codes_ref, labels_ref, out_ref, *, f: int, b: int,
         out_ref[c] += acc
 
 
+def _cooc_clsb_kernel(codes_ref, labels_ref, out_ref, *, f: int, b: int,
+                      wp: int, tr: int, n: int, nclass: int):
+    """Blocked per-class gram: grid (row-band, column-block), band outer.
+    Each step builds the full-width expansion for the column block plus a
+    BAND-LOCAL expansion of the band's TR = F·k rows (a whole number of
+    bins — Mosaic has no dynamic_slice, so the band is reconstructed from
+    the same static concat with its bin offset ``r·k`` folded into the
+    selector; both expansions together are negligible against the band
+    dot), then accumulates [C, TR, wp] into the HBM-resident G's band
+    (revisited across column blocks, initialized at block 0)."""
+    r = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ct = codes_ref[:]                                  # [F, BN] int32
+    y = labels_ref[:]                                  # [1, BN] int32
+    bn = ct.shape[1]
+    k = tr // f                                        # bins per band
+    nb_pad = wp // f                                   # padded bin count
+    code = jnp.where((ct >= 0) & (ct < b), ct, _INVALID)
+    if n % bn or n == 0:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+        code = jnp.where(lane < n - i * bn, code, _INVALID)
+    # full-width expansion: row w holds (code[w % f] == w // f)
+    jrept = jnp.concatenate([code] * nb_pad, axis=0)   # [Wp, BN]
+    jw = jax.lax.broadcasted_iota(jnp.int32, (wp, 1), 0)
+    jsel = jnp.where(jw // f < b, jw // f, _PAD_SEL)
+    hit = jrept == jsel                                # [Wp, BN]
+    # band-local expansion: bins [r·k, (r+1)·k), same static concat with
+    # the scalar bin offset folded into the selector
+    brept = jnp.concatenate([code] * k, axis=0)        # [TR, BN]
+    bw = jax.lax.broadcasted_iota(jnp.int32, (tr, 1), 0)
+    bbin = r * k + bw // f
+    bsel = jnp.where(bbin < b, bbin, _PAD_SEL)
+    bhit = brept == bsel                               # [TR, BN]
+    for c in range(nclass):
+        xb = (bhit & (y == c)).astype(jnp.int8)        # [TR, BN]
+        xt = (hit & (y == c)).astype(jnp.int8)         # [Wp, BN]
+        acc = jax.lax.dot_general(xb, xt, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        out_ref[c] += acc                              # [TR, Wp] band
+
+
 @functools.partial(jax.jit, static_argnames=(
     "num_bins", "num_classes", "block_cols", "interpret"))
 def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
@@ -278,7 +389,8 @@ def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
     materialization anywhere (fused into the kernel)."""
     f, n = codes_t.shape
     mode, jcp, wp = plan(f, num_bins, num_classes)
-    out_shape = ((num_classes, wp, wp) if mode == "cls" else (wp, wp))
+    out_shape = ((num_classes, wp, wp) if mode in ("cls", "clsb")
+                 else (wp, wp))
     if n == 0:
         # empty chunk (e.g. a stream's empty final block): zero counts,
         # matching the einsum path — the kernel's OOB block read would
@@ -289,6 +401,26 @@ def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
     ct = codes_t.astype(jnp.int32)
     y2 = labels.reshape(1, n).astype(jnp.int32)
     npad = _ru(max(n, bn), bn)
+    if mode == "clsb":
+        tr, _wp2 = clsb_tile(f, num_bins, num_classes)
+        kernel = functools.partial(_cooc_clsb_kernel, f=f, b=num_bins,
+                                   wp=wp, tr=tr, n=n, nclass=num_classes)
+        return pl.pallas_call(
+            kernel,
+            grid=(wp // tr, npad // bn),
+            in_specs=[pl.BlockSpec((f, bn), lambda r, i: (0, i),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, bn), lambda r, i: (0, i),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((num_classes, tr, wp),
+                                   lambda r, i: (0, r, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.int32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary"),
+                vmem_limit_bytes=110 * 1024 * 1024),
+            interpret=interpret,
+        )(ct, y2)
     if mode == "cls":
         kernel = functools.partial(_cooc_cls_kernel, f=f, b=num_bins,
                                    wp=wp, n=n, nclass=num_classes)
@@ -380,7 +512,8 @@ def applicable(num_feat: int, num_bins: int, num_classes: int) -> bool:
     if num_feat * num_bins * num_classes <= 0:
         return False
     mode, _, wp = plan(num_feat, num_bins, num_classes)
-    return wp <= (MAX_W_CLS if mode == "cls" else MAX_W)
+    # the per-class modes are only ever returned with their gates passed
+    return mode in ("cls", "clsb") or wp <= MAX_W
 
 
 def use_kernel(num_feat: int, num_bins: int, num_classes: int,

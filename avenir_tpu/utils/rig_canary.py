@@ -58,9 +58,16 @@ def _slope_ms(step_scalar, operand, reps_lo: int = 2, reps_hi: int = 10) -> floa
         np.asarray(jax.device_get(carry))
         return time.perf_counter() - t0
 
-    run(2)                                   # compile + warm
-    t_lo = run(reps_lo)
-    t_hi = run(reps_hi)
+    run(2)                                   # compile
+    run(reps_hi)                             # full-length warm: the first
+    # post-startup chain runs with lazy transport/allocator init still in
+    # flight (a process's first canary measured a 0.0 slope once)
+    # min-of-2 per point: a single transient stall in either chain can
+    # collapse (or explode) the slope — the embedded round-5 bench run
+    # recorded a 1.14 ms knn-dot "bound" (physically impossible for the
+    # ~30 ms of MXU work) from exactly that; minima resist one-off stalls
+    t_lo = min(run(reps_lo) for _ in range(2))
+    t_hi = min(run(reps_hi) for _ in range(2))
     return max((t_hi - t_lo) * 1e3 / (reps_hi - reps_lo), 0.0)
 
 

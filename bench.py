@@ -156,9 +156,10 @@ def main():
     from avenir_tpu.utils.roofline import chip_peaks, mfu_fields
     bytes_per_row = 4 * (n_feat + 1)
     mode, _, wp = pallas_hist.plan(n_feat, n_bins, n_classes)
-    # cls mode performs C sequential wp×wp grams per block → 2·C·wp² MACs
-    # per row; the joint modes do one wp×wp gram (2·wp²).
-    per_row = 2 * n_classes * wp * wp if mode == "cls" else 2 * wp * wp
+    # the per-class modes perform C sequential wp×wp grams per block →
+    # 2·C·wp² MACs per row; the joint modes do one wp×wp gram (2·wp²).
+    per_row = (2 * n_classes * wp * wp if mode in ("cls", "clsb")
+               else 2 * wp * wp)
     int8_ops_per_row = per_row if kernel_path else 0
     line = {
         "metric": "nb_mi_pipeline_throughput",
@@ -192,6 +193,13 @@ def main():
                         "verified_vs_oracle", "mfu_pct",
                         "canary_matmul_4096_bf16_ms", "canary_knn_dot_ms")
                        if kf in knn}
+
+        # per-family driver numbers (round-4 item 5): tree/viterbi/lr/cramer
+        # at reduced shapes with measured single-core baselines, so
+        # BENCH_r*.json — not BASELINE.md prose — carries every family's
+        # value AND its vs_baseline ratio (same chained-sync discipline)
+        from benchmarks.family_bench import families_summary
+        line["families"] = families_summary(passes=2)
     print(json.dumps(line))
 
 

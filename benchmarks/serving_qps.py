@@ -85,6 +85,72 @@ def single_event_latencies(n: int = 20_000):
     return np.asarray(lats)
 
 
+class _HeavyWrap:
+    """Learner wrapper whose action selection first burns pure-Python CPU
+    WHILE HOLDING THE GIL — the worst case for thread workers and the
+    justifying case for process workers (round-4 verdict item 7)."""
+
+    def __init__(self, inner, burn_loops: int):
+        self._inner = inner
+        self._burn = burn_loops
+
+    def next_actions(self, round_num):
+        acc = 0
+        for i in range(self._burn):          # pure-Python GIL-holding burn
+            acc += i & 7
+        self._sink = acc
+        return self._inner.next_actions(round_num)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def gil_contention_probe(n_events: int = 3000, burn_loops: int = 60_000):
+    """Thread vs process fleet at ONE worker each under a GIL-holding
+    CPU-bound learner, against the no-fleet per-event cost.
+
+    What this CAN demonstrate on the 1-core dev rig: the measured
+    per-event cost of each dispatch path under load — the thread fleet is
+    bounded by GIL serialization (≈ the pure cost: dispatcher and worker
+    interleave on one lock), the process fleet adds measurable IPC on
+    top of OS scheduling.  What it CANNOT demonstrate here: the
+    multi-core win — with W cores and W process workers the same
+    GIL-holding update scales ~W× while thread workers stay at the pure
+    rate; that claim is an EXTRAPOLATION from this measurement, labeled
+    as such in BASELINE.md."""
+    def heavy_server(_group: str) -> st.ReinforcementLearnerServer:
+        learner = _HeavyWrap(
+            orl.create_learner("intervalEstimator", ACTIONS, CONF, seed=3),
+            burn_loops)
+        return st.ReinforcementLearnerServer(
+            learner, st.QueueEventSource(st.InProcQueue()),
+            st.QueueRewardReader(st.InProcQueue()),
+            st.QueueActionWriter(st.InProcQueue()))
+
+    # no-fleet reference: the bare serve loop, one event at a time
+    srv = heavy_server("g")
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        srv.events.queue.push(f"ev{i},{i}")
+        srv.process_one()
+        srv.actions.queue.pop()
+    pure = n_events / (time.perf_counter() - t0)
+
+    out = {"pure_events_per_sec": round(pure, 1)}
+    for label, cls in (("thread", st.ShardedServingFleet),
+                       ("process", st.ProcessServingFleet)):
+        fleet = cls(heavy_server, num_workers=1, max_pending=256)
+        t0 = time.perf_counter()
+        for i in range(n_events):
+            fleet.dispatch(f"g{i % 8}", f"ev{i}", i)
+        fleet.close()
+        rate = n_events / (time.perf_counter() - t0)
+        out[f"{label}_events_per_sec"] = round(rate, 1)
+        out[f"{label}_per_event_overhead_us"] = round(
+            (1.0 / rate - 1.0 / pure) * 1e6, 1)
+    return out
+
+
 def main():
     rates = {w: round(fleet_events_per_sec(w), 1) for w in (1, 2, 4)}
     proc_rates = {w: round(process_fleet_events_per_sec(w), 1)
@@ -100,6 +166,7 @@ def main():
         "p99_latency_us": round(float(np.percentile(lats, 99)) * 1e6, 1),
         "groups": 32,
         "learner": "intervalEstimator",
+        "gil_contention_1worker": gil_contention_probe(),
     }))
 
 

@@ -44,7 +44,7 @@ def main():
 
     if args.path == "kernel":
         mode, jcp, wp = pallas_hist.plan(f, b, c)
-        assert mode == "cls", f"shape routes to {mode}, not cls"
+        assert mode in ("cls", "clsb"), f"shape routes to {mode}"
         dcodes = jnp.asarray(np.ascontiguousarray(codes.T))
         dlabels = jnp.asarray(labels)
 
