@@ -239,7 +239,7 @@ def sharded_mi_step(mesh: Mesh, num_classes: int, num_bins: int,
 
 
 def sharded_cooc_step(mesh: Mesh, num_bins: int, num_classes: int,
-                      interpret: bool = False):
+                      interpret: bool = False, block_cols=None):
     """Data-sharded MXU co-occurrence count step (the round-3 count kernel
     under explicit SPMD): each device runs the Pallas XᵀX kernel
     (ops/pallas_hist.py) over its local rows — the per-device partial is
@@ -255,7 +255,8 @@ def sharded_cooc_step(mesh: Mesh, num_bins: int, num_classes: int,
 
     def step(codes, labels):
         g = pallas_hist.cooc_counts.__wrapped__(
-            codes, labels, num_bins, num_classes, interpret=interpret)
+            codes, labels, num_bins, num_classes, interpret=interpret,
+            block_cols=block_cols)
         return jax.lax.psum(g, "data")
 
     # norep: pallas_call outputs don't carry varying-mesh-axis metadata, so

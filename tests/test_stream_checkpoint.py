@@ -52,7 +52,9 @@ def _part(path):
 
 
 @pytest.mark.parametrize("job_name", ["BayesianDistribution",
-                                      "MutualInformation"])
+                                      "MutualInformation",
+                                      "CramerCorrelation",
+                                      "HeterogeneityReductionCorrelation"])
 def test_kill_and_resume_byte_identical(tmp_path, workload, job_name):
     csv, conf = workload
     clean_out = tmp_path / "clean"
