@@ -168,6 +168,41 @@ def test_wide_clsb_kernel_matches_einsum(rng, f, b, c):
     np.testing.assert_array_equal(np.asarray(pair_k), np.asarray(pair_e))
 
 
+def test_fit_fast_path_matches_einsum_clsb_shape(rng, monkeypatch):
+    """MutualInformation.fit end-to-end on a shape that routes to the
+    round-5 BLOCKED per-class tier (forced on, interpret, small column
+    block) — counts and MI values identical to the einsum path."""
+    import functools
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.models.mutual_info import MutualInformation
+
+    f, b, c, n = 40, 10, 12, 400
+    assert pallas_hist.plan(f, b, c)[0] == "clsb"
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+
+    def mk():
+        return EncodedDataset(codes=codes, cont=np.zeros((n, 0), np.float32),
+                              labels=labels, n_bins=np.full(f, b, np.int32),
+                              class_values=[str(i) for i in range(c)],
+                              binned_ordinals=list(range(f)))
+
+    baseline = MutualInformation().fit(mk())
+    monkeypatch.setattr(pallas_hist, "on_tpu_single_device",
+                        lambda *a: True)
+    monkeypatch.setattr(
+        pallas_hist, "cooc_counts",
+        functools.partial(pallas_hist.cooc_counts.__wrapped__,
+                          interpret=True, block_cols=512))
+    fast = MutualInformation().fit(mk())
+    np.testing.assert_array_equal(fast.feature_class_counts,
+                                  baseline.feature_class_counts)
+    np.testing.assert_array_equal(fast.pair_class_counts,
+                                  baseline.pair_class_counts)
+    np.testing.assert_allclose(fast.feature_class_mi,
+                               baseline.feature_class_mi, rtol=1e-6)
+
+
 def test_clsb_tiling_and_gates():
     # the verdict's example: 100 feat × 20 bins × 2 classes stays on MXU
     assert pallas_hist.plan(100, 20, 2) == ("clsb", 20, 2000)
