@@ -214,6 +214,62 @@ def node_bin_class_counts(
     return t.reshape(t.shape[0], t.shape[1], num_nodes, c)
 
 
+@functools.partial(jax.jit, static_argnames=("num_nodes", "num_classes",
+                                             "num_bins", "interpret"))
+def _level_table_cross(codes_t: jax.Array, node_ids: jax.Array,
+                       labels: jax.Array, num_nodes: int, num_classes: int,
+                       num_bins: int, interpret: bool = False) -> jax.Array:
+    """The level table via the fused cross-gram kernel
+    (``pallas_hist.cross_cooc_counts_cols``): X = (feature, bin) one-hot,
+    Y = (node, class) one-hot, table = XᵀY on the int8 MXU with both
+    expansions in VMEM — the einsum form's [N, F, B] HBM one-hot
+    (~400 B/row/level) becomes a ~24 B/row code stream.  Bit-identical
+    counts (int8 0/1 operands, int32 accumulation; invalid codes, settled
+    rows and out-of-range labels all drop out exactly as the einsum's
+    zero one-hot rows)."""
+    from avenir_tpu.ops import pallas_hist
+
+    c = num_classes
+    valid = (node_ids >= 0) & (labels >= 0) & (labels < c)
+    sel = jnp.where(valid, node_ids * c + labels, -1)
+    t = pallas_hist.cross_cooc_counts_cols.__wrapped__(
+        codes_t, sel, num_bins, num_nodes * c, interpret=interpret)
+    return t.reshape(t.shape[0], t.shape[1], num_nodes, c)
+
+
+@jax.jit
+def _remap_nodes(node: jax.Array, remap: jax.Array) -> jax.Array:
+    """[N] absolute node ids → frontier-local indices (−1 = settled)."""
+    return remap[jnp.maximum(node, 0)]
+
+
+@jax.jit
+def _apply_level_partition(codes: jax.Array, node: jax.Array,
+                           remap: jax.Array, attr: jax.Array,
+                           child_tab: jax.Array) -> jax.Array:
+    """Device-side frontier partition: rows of frontier node ki whose
+    level-chosen split routes bin b to child ``child_tab[ki, b]`` move
+    there; settled rows and unsplit frontier rows (child −1) keep their
+    id.  The [N] node vector thus lives ON DEVICE across levels — per
+    level only KB-sized tables travel (remap, per-node split attr, the
+    bin→child table), replacing the round-4 host partition + full [N]
+    re-upload whose tunnel round trips dominated induction time on the
+    dev rig (and are pure waste on any host).
+
+    A −1 (invalid) code indexes the LAST bin — the same semantics the
+    host path inherited from numpy's negative indexing, kept so the
+    device partition is bit-identical to it."""
+    local = _remap_nodes.__wrapped__(node, remap)
+    lc = jnp.maximum(local, 0)
+    a = attr[lc]                                             # [N]
+    code = jnp.take_along_axis(codes, a[:, None], axis=1)[:, 0]
+    b = child_tab.shape[1]
+    code = jnp.where(code < 0, code + b, code)
+    code = jnp.clip(code, 0, b - 1)
+    new = child_tab[lc, code]
+    return jnp.where((local >= 0) & (new >= 0), new, node)
+
+
 def split_histograms_from_table(table_a: np.ndarray,
                                 chunk: Sequence["CandidateSplit"],
                                 gmax: int) -> np.ndarray:
@@ -476,12 +532,26 @@ class DecisionTree:
         # uploaded ONCE; per level only the [N] node-id vector travels.
         labels_dev = maybe_shard_batch(self.mesh, ds.labels)[0]
         codes_dev = maybe_shard_batch(self.mesh, ds.codes)[0]
+        # single-TPU fast path for the level table: the fused cross-gram
+        # kernel streams columnar codes (one device transpose, once)
+        from avenir_tpu.ops import pallas_hist
+        # the X-side gate (feature/bin width) is level-independent: check
+        # it before paying the device transpose + second HBM codes copy
+        use_cross = (self.mesh is None and pallas_hist.on_tpu_single_device()
+                     and pallas_hist.cross_applicable(
+                         ds.num_binned, ds.max_bins, max(c, 1)))
+        codes_t_dev = codes_dev.T if use_cross else None
         all_splits = generate_candidate_splits(
             ds, self.max_split, is_categorical, self.max_candidates_per_attr)
 
         root_counts = np.bincount(ds.labels, minlength=c).astype(np.float64)
         nodes: List[TreeNode] = [TreeNode(0, 0, root_counts)]
-        node_of_record = np.zeros(n, np.int32)
+        # the [N] per-row node assignment lives ON DEVICE for the whole
+        # fit (round 5): per level only KB-sized tables travel — the
+        # round-4 form re-uploaded the remapped [N] vector every level
+        # and partitioned on host, paying two N-sized tunnel trips per
+        # level that dominated induction wall time on the dev rig
+        node_dev = jnp.zeros(labels_dev.shape[0], jnp.int32)
         frontier = [0]
 
         for depth in range(self.max_depth):
@@ -492,12 +562,18 @@ class DecisionTree:
             remap = np.full(len(nodes), -1, np.int32)
             for i, nid in enumerate(frontier):
                 remap[nid] = i
-            local_node = remap[node_of_record]                 # −1 for settled rows
-            local_node_dev = maybe_shard_batch(self.mesh, local_node)[0]
+            remap_dev = jnp.asarray(remap)
+            local_node_dev = _remap_nodes(node_dev, remap_dev)
             # ONE device round trip per level: the [F, B, K, C] table; all
             # candidate histograms and scores derive from it on host
-            table = np.asarray(node_bin_class_counts(
-                codes_dev, local_node_dev, labels_dev, k, c, ds.max_bins))
+            if use_cross and pallas_hist.cross_applicable(
+                    ds.num_binned, ds.max_bins, k * c):
+                table = np.asarray(_level_table_cross(
+                    codes_t_dev, local_node_dev, labels_dev, k, c,
+                    ds.max_bins))
+            else:
+                table = np.asarray(node_bin_class_counts(
+                    codes_dev, local_node_dev, labels_dev, k, c, ds.max_bins))
 
             best_per_node: List[List[Tuple[float, CandidateSplit, np.ndarray]]] = [
                 [] for _ in range(k)]
@@ -510,6 +586,8 @@ class DecisionTree:
                                                   hist[si, :, ki, :]))
             # select per node: best or random among top_n
             new_frontier: List[int] = []
+            attr_arr = np.zeros(k, np.int32)
+            child_tab = np.full((k, ds.max_bins), -1, np.int32)
             for ki, nid in enumerate(frontier):
                 node = nodes[nid]
                 cands = sorted(best_per_node[ki], key=lambda t: -t[0])[:max(self.top_n, 1)]
@@ -535,12 +613,18 @@ class DecisionTree:
                     nodes.append(ch)
                     if seg_counts[g] >= self.min_node_size and depth + 1 < self.max_depth:
                         new_frontier.append(ch.node_id)
-                # partition: vectorized segment gather (replaces the
-                # one-reducer-per-segment MR job + HDFS renames)
-                mask = node_of_record == nid
-                segs = sp.seg_of_bin[ds.codes[mask, sp.attr]]
+                # partition: routed through the device-resident node
+                # vector (replaces the one-reducer-per-segment MR job +
+                # HDFS renames of DataPartitioner.java:95-129)
                 child_ids = np.asarray(node.children, np.int32)
-                node_of_record[mask] = child_ids[segs]
+                attr_arr[ki] = sp.attr
+                child_tab[ki] = child_ids[sp.seg_of_bin]
+            # no next level (or nothing split) → the updated vector would
+            # never be read; skip the dispatch
+            if new_frontier and (child_tab >= 0).any():
+                node_dev = _apply_level_partition(
+                    codes_dev, node_dev, remap_dev,
+                    jnp.asarray(attr_arr), jnp.asarray(child_tab))
             frontier = new_frontier
         return DecisionTreeModel(nodes=nodes, class_values=list(ds.class_values),
                                  max_bins=ds.max_bins, algorithm=self.algorithm)

@@ -447,6 +447,105 @@ def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
     )(ct, y2)
 
 
+def _cross_kernel(codes_ref, sel_ref, out_ref, *, f: int, b: int, jcp: int,
+                  wp: int, sp_dim: int, n: int, nsel: int):
+    """Cross co-occurrence XᵀY: X = the (feature, bin) one-hot (fmaj
+    broadcast expansion, exactly the count kernel's), Y = the one-hot of
+    an arbitrary selector code (e.g. node·C + class for the decision
+    tree's level table).  Both expansions live only in VMEM."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ct = codes_ref[:]                                  # [F, BN] int32
+    s = sel_ref[:]                                     # [1, BN] int32
+    bn = ct.shape[1]
+    code = jnp.where((ct >= 0) & (ct < b), ct, _INVALID)
+    sel = jnp.where((s >= 0) & (s < nsel), s, _INVALID)
+    if n % bn or n == 0:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+        live = lane < n - i * bn
+        code = jnp.where(live, code, _INVALID)
+        sel = jnp.where(live, sel, _INVALID)
+    jv = jax.lax.broadcasted_iota(jnp.int32, (1, jcp, 1), 1)
+    xt = (code[:, None, :] == jv).astype(jnp.int8).reshape(f * jcp, bn)
+    if wp > f * jcp:
+        xt = jnp.concatenate(
+            [xt, jnp.zeros((wp - f * jcp, bn), jnp.int8)], axis=0)
+    sv = jax.lax.broadcasted_iota(jnp.int32, (sp_dim, 1), 0)
+    yt = (sel == sv).astype(jnp.int8)                  # [Sp, BN]
+    out_ref[:] += jax.lax.dot_general(xt, yt, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.int32)
+
+
+MAX_SEL_CROSS = 1024
+
+
+def cross_applicable(num_feat: int, num_bins: int, num_sel: int) -> bool:
+    """Gate for the cross kernel: the X side obeys the joint-gram width
+    cap and the selector side stays small (its padded lane width scales
+    the dot work linearly)."""
+    if num_feat * num_bins <= 0 or num_sel <= 0:
+        return False
+    jcp = _ru(num_bins, 32)
+    wp = _ru(num_feat * jcp, 128)
+    return wp <= MAX_W and num_sel <= MAX_SEL_CROSS
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_bins", "num_sel", "block_cols", "interpret"))
+def cross_cooc_counts_cols(codes_t: jax.Array, sel: jax.Array,
+                           num_bins: int, num_sel: int, *,
+                           block_cols: int | None = None,
+                           interpret: bool = False) -> jax.Array:
+    """codes_t [F, N] int (columnar), sel [N] int (−1/out-of-range rows
+    drop out) → [F, B, num_sel] int32 counts of each (feature, bin,
+    selector) co-occurrence — computed as the int8-MXU cross gram XᵀY
+    with both one-hots expanded in VMEM (never in HBM).
+
+    The decision tree's per-level [F, B, K, C] table is this with
+    sel = node·C + class (``models/tree.py::node_bin_class_counts``):
+    the einsum form it replaces materializes the [N, F, B] one-hot in
+    HBM (~400 B/row/level at the retarget shape vs the ~24 B/row the
+    kernel streams)."""
+    f, n = codes_t.shape
+    jcp = _ru(num_bins, 32)
+    wp = _ru(f * jcp, 128)
+    sp_dim = _ru(num_sel, 128)
+    if n == 0:
+        return jnp.zeros((f, num_bins, num_sel), jnp.int32)
+    # budget BOTH int8 expansions ([wp, BN] X and [sp_dim, BN] Y) against
+    # the VMEM limit — the fmaj budget alone ignores Y and a large padded
+    # selector width could push past vmem_limit_bytes at compile time
+    bn = block_cols or max(128, min(
+        _DEFAULT_BN,
+        (72 * 1024 * 1024) // max(wp + sp_dim, 128)) // 128 * 128)
+    ct = codes_t.astype(jnp.int32)
+    s2 = sel.reshape(1, n).astype(jnp.int32)
+    npad = _ru(max(n, bn), bn)
+    kernel = functools.partial(_cross_kernel, f=f, b=num_bins, jcp=jcp,
+                               wp=wp, sp_dim=sp_dim, n=n, nsel=num_sel)
+    g = pl.pallas_call(
+        kernel,
+        grid=(npad // bn,),
+        in_specs=[pl.BlockSpec((f, bn), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, bn), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((wp, sp_dim), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((wp, sp_dim), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(ct, s2)
+    # [Wp, Sp] → [F, B, num_sel]: row f·jcp + b (wp padding dropped), col s
+    return g[:f * jcp].reshape(f, jcp, sp_dim)[:, :num_bins, :num_sel]
+
+
 @functools.partial(jax.jit, static_argnames=(
     "num_bins", "num_classes", "block_cols", "interpret"))
 def cooc_counts(codes: jax.Array, labels: jax.Array, num_bins: int,

@@ -203,6 +203,52 @@ def test_fit_fast_path_matches_einsum_clsb_shape(rng, monkeypatch):
                                baseline.feature_class_mi, rtol=1e-6)
 
 
+def test_cross_cooc_matches_einsum_level_table(rng):
+    """The tree's fused cross-gram level table (round 5) must be
+    bit-identical to node_bin_class_counts' einsum, including invalid
+    codes, settled rows (node −1) and out-of-range labels."""
+    from avenir_tpu.models import tree as dtree
+
+    n, f, b, k, c = 700, 5, 7, 3, 2
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    node = rng.integers(-1, k, size=n).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    codes[rng.integers(0, n, 25), rng.integers(0, f, 25)] = -1
+    codes[rng.integers(0, n, 10), rng.integers(0, f, 10)] = b + 3
+    labels[rng.integers(0, n, 12)] = -1
+    labels[rng.integers(0, n, 6)] = c + 1
+    ref = np.asarray(dtree.node_bin_class_counts(
+        jnp.asarray(codes), jnp.asarray(node), jnp.asarray(labels), k, c, b))
+    got = np.asarray(dtree._level_table_cross(
+        jnp.asarray(codes.T.copy()), jnp.asarray(node), jnp.asarray(labels),
+        k, c, b, interpret=True))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_apply_level_partition_matches_host(rng):
+    """Device-side frontier partition == the round-4 host partition
+    (numpy negative-index wrap for −1 codes included)."""
+    from avenir_tpu.models import tree as dtree
+
+    n, f, b, k = 500, 4, 6, 3
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    codes[rng.integers(0, n, 20), rng.integers(0, f, 20)] = -1
+    node = rng.integers(0, 5, size=n).astype(np.int32)     # absolute ids 0..4
+    remap = np.array([0, -1, 1, -1, 2], np.int32)          # frontier {0,2,4}
+    attr = np.array([1, 3, 0], np.int32)
+    child_tab = rng.integers(5, 11, size=(k, b)).astype(np.int32)
+    child_tab[1] = -1                                      # unsplit node
+    got = np.asarray(dtree._apply_level_partition(
+        jnp.asarray(codes), jnp.asarray(node), jnp.asarray(remap),
+        jnp.asarray(attr), jnp.asarray(child_tab)))
+    exp = node.copy()
+    for ki, nid in enumerate([0, 2, 4]):
+        mask = node == nid
+        seg = child_tab[ki][codes[mask, attr[ki]]]         # numpy -1 wraps
+        exp[mask] = np.where(seg >= 0, seg, exp[mask])
+    np.testing.assert_array_equal(got, exp)
+
+
 def test_clsb_tiling_and_gates():
     # the verdict's example: 100 feat × 20 bins × 2 classes stays on MXU
     assert pallas_hist.plan(100, 20, 2) == ("clsb", 20, 2000)
