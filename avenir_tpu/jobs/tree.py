@@ -82,9 +82,18 @@ class ClassPartitionGenerator(Job):
             mesh, ds.codes, ds.labels, np.zeros(ds.num_rows, np.int32))
         # ONE device contraction for the whole job: the [F, B, 1, C] table;
         # every candidate split's histogram derives from it on host (the
-        # same factoring DecisionTree.fit uses per level)
-        table = np.asarray(dtree.node_bin_class_counts(
-            codes_dev, node_ids, labels, 1, ds.num_classes, ds.max_bins))
+        # same factoring — and the same single-TPU cross-gram fast path —
+        # DecisionTree.fit uses per level)
+        from avenir_tpu.ops import pallas_hist
+        if (mesh is None and pallas_hist.on_tpu_single_device()
+                and pallas_hist.cross_applicable(
+                    ds.num_binned, ds.max_bins, ds.num_classes)):
+            table = np.asarray(dtree._level_table_cross(
+                codes_dev.T, node_ids, labels, 1, ds.num_classes,
+                ds.max_bins))
+        else:
+            table = np.asarray(dtree.node_bin_class_counts(
+                codes_dev, node_ids, labels, 1, ds.num_classes, ds.max_bins))
         lines: List[str] = []
         out_distr = conf.get_bool("output.split.prob", False)
         split_chunk = conf.get_int("split.chunk", 128)

@@ -71,8 +71,13 @@ def _slope_ms(step_scalar, operand, reps_lo: int = 2, reps_hi: int = 10) -> floa
     return max((t_hi - t_lo) * 1e3 / (reps_hi - reps_lo), 0.0)
 
 
-def matmul_canary_ms(dim: int = 4096, reps: int = 8) -> float:
-    """Chained ``dim³`` bf16 matmul, per-call ms (2·dim³ FLOPs/call)."""
+def matmul_canary_ms(dim: int = 4096, reps: int = 32) -> float:
+    """Chained ``dim³`` bf16 matmul, per-call ms (2·dim³ FLOPs/call).
+
+    ``reps`` sized so the chain differential (~reps · 5 ms) clearly
+    exceeds the tunnel's per-fetch RTT variance — at 8 reps the ~40 ms
+    signal drowned in RTT noise inside long-lived processes (embedded
+    artifacts read 0.0/0.22 ms for a ~5 ms matmul)."""
     a = jnp.asarray(np.random.default_rng(0).normal(
         size=(dim, dim)).astype(np.float32)).astype(jnp.bfloat16)
 
@@ -88,7 +93,7 @@ def matmul_canary_ms(dim: int = 4096, reps: int = 8) -> float:
 
 
 def knn_dot_canary_ms(batch: int = 16384, n_refs: int = 1_000_000,
-                      width: int = 128, reps: int = 4,
+                      width: int = 128, reps: int = 8,
                       refs=None) -> float:
     """Chained bare distance dot at the kNN serving shape, per-call ms.
 

@@ -343,7 +343,10 @@ FAMILIES = {"tree": bench_tree, "viterbi": bench_viterbi, "lr": bench_lr,
 # reduced shapes for the driver artifact (bench.py embeds these; ~10 s
 # budget per family including its baseline, same chained-sync discipline)
 REDUCED = {
-    "tree": dict(n=300_000, baseline_sub=50_000),
+    # tree keeps 1M rows: the ~100 ms per-level host sync amortizes over
+    # N, and at 300k rows it dominated (447k rows/s where the 2M shape
+    # measures 1.36M — same dispatch-floor distortion as LR's)
+    "tree": dict(n=1_000_000, baseline_sub=50_000),
     "viterbi": dict(r=16_000, t=210, baseline_sub=100),
     # LR keeps the full 4M-row shape: at 1M rows the ~11 ms device
     # dispatch floor dominates and the ratio collapses to ~1.2× while the
