@@ -77,7 +77,16 @@ def matmul_canary_ms(dim: int = 4096, reps: int = 32) -> float:
     ``reps`` sized so the chain differential (~reps · 5 ms) clearly
     exceeds the tunnel's per-fetch RTT variance — at 8 reps the ~40 ms
     signal drowned in RTT noise inside long-lived processes (embedded
-    artifacts read 0.0/0.22 ms for a ~5 ms matmul)."""
+    artifacts read 0.0/0.22 ms for a ~5 ms matmul).
+
+    INTERPRETATION: healthy readings are themselves noisy — fresh
+    processes measure ~4–6 ms, long-lived ones as low as ~0.1–1.5 ms
+    (the tunnel pipelines deeply enough to hide parts of a short chain
+    behind the fetch) — so treat any reading ≲ 7 ms as "healthy".  The
+    signal this canary exists for is the CONTENDED regime, which reads
+    10–100× higher (measured 167–192 ms under host-CPU load) and is
+    unmistakable.  The kNN dot canary (~250 ms of work per chain) sits
+    well above the noise and is the steadier of the two."""
     a = jnp.asarray(np.random.default_rng(0).normal(
         size=(dim, dim)).astype(np.float32)).astype(jnp.bfloat16)
 
