@@ -54,16 +54,31 @@ def main():
         def chain(out):
             return (out[0, 0, 0] * 0).astype(jnp.int32)
     else:
-        # the einsum path sweeps pairs in slices like MutualInformation.fit
+        # the einsum path sweeps pairs in 256-pair slices — EXACTLY how
+        # MutualInformation.fit's fallback runs (its pair_chunk default);
+        # the unchunked nb_mi_pipeline_step call a previous version timed
+        # OOMs HBM at wide F (its [N, P] broadcast intermediates scale
+        # with ALL pairs at once) and would under-report the einsum
         dcodes = jnp.asarray(codes)
         dlabels = jnp.asarray(labels)
+        pair_chunk = 256
+        slices = [(jnp.asarray(pi[s:s + pair_chunk, 0]),
+                   jnp.asarray(pi[s:s + pair_chunk, 1]))
+                  for s in range(0, len(pi), pair_chunk)]
 
         def step(bias):
-            return agg.nb_mi_pipeline_step(dcodes, dlabels + bias, ci, cj,
-                                           c, b)
+            y = dlabels + bias
+            fc = agg.feature_class_counts(dcodes, y, c, b)
+            outs = [agg.pair_class_counts(dcodes[:, si], dcodes[:, sj],
+                                          y, c, b)
+                    for si, sj in slices]
+            return fc, outs[-1]
 
         def chain(out):
-            return (out[0][0, 0, 0] * 0).astype(jnp.int32)
+            # chain through BOTH the fc tensor and the last pair slice so
+            # the final fetch barriers every dispatch of the pass
+            return ((out[0][0, 0, 0] + out[1][0, 0, 0, 0]) * 0).astype(
+                jnp.int32)
 
     def timed_pass():
         bias = jnp.int32(0)
