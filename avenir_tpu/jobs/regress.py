@@ -50,22 +50,37 @@ class LogisticRegressionJob(Job):
         # whole read-resume-train-rewrite cycle so a concurrent run is
         # detected (LockHeldError) instead of silently interleaving, and
         # replace the file atomically so readers never see a torn history.
-        # Under jax.distributed only process 0 (the writer) takes the lock;
-        # peers read the resume history without it — a peer's run() is only
-        # reachable through the same distributed launch, not a concurrent
-        # independent job.
+        # Under jax.distributed only process 0 (the writer) takes the lock
+        # and reads the resume history; peers receive it through the
+        # ``all_process_sum_state`` handshake — an unlocked independent
+        # peer read could observe a different (mid-rewrite or newer) file
+        # than the writer resumed from, silently desynchronizing the
+        # lockstep gradient fold.
         os.makedirs(os.path.dirname(coeff_path) or ".", exist_ok=True)
         lock = (FileLock(coeff_path,
                          timeout_s=conf.get_float("coeff.lock.timeout.sec", 10.0))
                 if self.is_output_writer() else contextlib.nullcontext())
+        import jax
+
         with lock:
             resume = None
-            if os.path.exists(coeff_path):
-                with open(coeff_path) as fh:
-                    lines = [ln for ln in fh if ln.strip()]
-                if lines:
-                    resume = mlr.LogisticRegressionModel.from_history_lines(
-                        lines, delim=conf.field_delim)
+            read_err = None
+            if self.is_output_writer() and os.path.exists(coeff_path):
+                try:
+                    with open(coeff_path) as fh:
+                        lines = [ln for ln in fh if ln.strip()]
+                    if lines:
+                        resume = mlr.LogisticRegressionModel.from_history_lines(
+                            lines, delim=conf.field_delim)
+                except Exception as e:
+                    # multi-process: the failure must travel THROUGH the
+                    # broadcast collective — peers no longer read the file
+                    # themselves, and a writer that raised before entering
+                    # the handshake would leave them hung in the allgather
+                    if jax.process_count() <= 1:
+                        raise
+                    read_err = f"{type(e).__name__}: {e}"
+            resume = self._broadcast_resume(resume, read_err)
             if conf.get("stream.chunk.rows"):
                 model, n_rows = self._fit_streaming(conf, input_path,
                                                     counters, est, resume)
@@ -87,6 +102,57 @@ class LogisticRegressionJob(Job):
         counters.set("Records", "Processed", n_rows)
         counters.set("Iterations", "Run", model.iterations)
         counters.set("Iterations", "Converged", int(model.converged))
+
+    @staticmethod
+    def _broadcast_resume(resume, read_err=None):
+        """Ship the writer's lock-protected resume history to every peer
+        through the same packed-gather collective the gradient fold uses
+        (``all_process_sum_state``): process 0 contributes the [iters, D]
+        history stack, peers contribute nothing (a missing key folds as
+        absent), and all processes reconstruct the identical model —
+        bitwise, since the raw float64 rows ride the wire rather than a
+        repr round-trip.  Every process enters exactly one collective, so
+        the sequence stays aligned with the per-iteration merges that
+        follow.  A writer-side read/pack failure (``read_err``, or a
+        ragged history that fails to stack) rides the same payload and
+        re-raises on EVERY process — for those failures the one
+        collective still happens, so no peer is left hung in the
+        allgather.  (A writer that dies BEFORE this point — e.g.
+        ``LockHeldError`` at lock acquisition — still strands peers at
+        their next collective; that is the pre-existing
+        writer-death-mid-job failure mode of every distributed run,
+        bounded by the distributed-runtime timeout, not something this
+        handshake changes.)  Single-process runs return ``resume``
+        untouched."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return resume
+        from avenir_tpu.parallel.mesh import all_process_sum_state
+
+        state = {}
+        if read_err is None and resume is not None:
+            try:
+                state["lr_resume_hist"] = np.stack(resume.history).astype(
+                    np.float64)
+            except Exception as e:   # e.g. ragged rows — must not skip the
+                read_err = f"{type(e).__name__}: {e}"   # collective below
+        if read_err is not None:
+            state["lr_resume_error"] = np.frombuffer(
+                read_err.encode(), np.uint8).copy()
+        folded = all_process_sum_state(state)
+        err = folded.get("lr_resume_error")
+        if err is not None:
+            raise ValueError(
+                "coefficient-history resume failed on the writer: "
+                + err.tobytes().decode(errors="replace"))
+        hist = folded.get("lr_resume_hist")
+        if hist is None:
+            return None
+        rows = [np.asarray(r) for r in hist]
+        return mlr.LogisticRegressionModel(
+            weights=rows[-1], history=rows, converged=False,
+            iterations=len(rows))
 
     def _fit_streaming(self, conf: JobConfig, input_path: str,
                        counters: Counters, est, resume):

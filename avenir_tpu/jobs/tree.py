@@ -29,6 +29,7 @@ from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import tree as dtree
 from avenir_tpu.utils.metrics import ConfusionMatrix, Counters
 
+import jax
 import jax.numpy as jnp
 
 
@@ -43,6 +44,12 @@ def _tree_params(conf: JobConfig) -> dict:
         user_attrs=conf.get_int_list("split.attributes"),
         random_k=conf.get_int("random.split.set.size"),
         top_n=conf.get_int("num.top.splits", 1),
+        # split.selection.path device|host: where per-level split scoring/
+        # selection runs (byte-identical trees either way — see
+        # models/tree.py); split.search exhaustive|binary picks the
+        # candidate family (binary = sorted-threshold sklearn-comparable)
+        selection=conf.get("split.selection.path", "device"),
+        split_search=conf.get("split.search", "exhaustive"),
     )
 
 
@@ -72,7 +79,8 @@ class ClassPartitionGenerator(Job):
         schema = self.load_schema(conf)
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]
-        all_splits = dtree.generate_candidate_splits(ds, p["max_split"], is_cat)
+        all_splits = dtree.candidate_splits_for(
+            ds, p["split_search"], p["max_split"], is_cat)
         # honor the reference's externally supplied parent info content (from
         # the at.root bootstrap); default = derive from the node itself
         parent_info = conf.get_float("parent.info")
@@ -80,36 +88,59 @@ class ClassPartitionGenerator(Job):
         mesh = self.auto_mesh(conf)
         codes_dev, labels, node_ids = maybe_shard_batch(
             mesh, ds.codes, ds.labels, np.zeros(ds.num_rows, np.int32))
-        # ONE device contraction for the whole job: the [F, B, 1, C] table;
-        # every candidate split's histogram derives from it on host (the
-        # same factoring — and the same single-TPU cross-gram fast path —
-        # DecisionTree.fit uses per level)
+        # ONE device contraction for the whole job: the [F, B, 1, C] table
+        # (the same factoring — and the same single-TPU cross-gram fast
+        # path — DecisionTree.fit uses per level)
         from avenir_tpu.ops import pallas_hist
         if (mesh is None and pallas_hist.on_tpu_single_device()
                 and pallas_hist.cross_applicable(
                     ds.num_binned, ds.max_bins, ds.num_classes)):
-            table = np.asarray(dtree._level_table_cross(
+            table_dev = dtree._level_table_cross(
                 codes_dev.T, node_ids, labels, 1, ds.num_classes,
-                ds.max_bins))
+                ds.max_bins)
         else:
-            table = np.asarray(dtree.node_bin_class_counts(
-                codes_dev, node_ids, labels, 1, ds.num_classes, ds.max_bins))
-        lines: List[str] = []
+            table_dev = dtree.node_bin_class_counts(
+                codes_dev, node_ids, labels, 1, ds.num_classes, ds.max_bins)
         out_distr = conf.get_bool("output.split.prob", False)
         split_chunk = conf.get_int("split.chunk", 128)
-        for a, chunk, scores, hist in dtree.iter_scored_splits(
-                table, all_splits, p["algorithm"], split_chunk,
-                parent_info=parent_info):
-            ordinal = ds.binned_ordinals[a]
-            for si, sp in enumerate(chunk):
-                row = [str(ordinal), sp.key, f"{float(scores[si, 0]):.6f}"]
-                if out_distr:
-                    hh = hist[si, :, 0, :]                            # [G, C]
-                    tot = np.maximum(hh.sum(-1, keepdims=True), 1e-9)
-                    for g in range(sp.num_segments):
-                        row.append(":".join(
-                            f"{v:.4f}" for v in (hh[g] / tot[g])))
-                lines.append(";".join(row))
+
+        def emit_row(sp, score, hh) -> str:
+            # ONE formatter for both scoring paths — the device/host
+            # line-identity contract is asserted by
+            # test_class_partition_generator_device_matches_host
+            row = [str(ds.binned_ordinals[sp.attr]), sp.key,
+                   f"{float(score):.6f}"]
+            if out_distr:                                 # hh: [G, C]
+                tot = np.maximum(hh.sum(-1, keepdims=True), 1e-9)
+                for g in range(sp.num_segments):
+                    row.append(":".join(
+                        f"{v:.4f}" for v in (hh[g] / tot[g])))
+            return ";".join(row)
+
+        lines: List[str] = []
+        flat = (dtree.flatten_splits(all_splits, ds.max_bins, split_chunk)
+                if p["selection"] == "device" else None)
+        if flat is not None and flat.num_real:
+            # batched device scoring: every candidate's histogram + score in
+            # one dispatch against the resident table; the fetch is the
+            # [S, 1] score sheet (plus the small [S, G, 1, C] histograms
+            # only when the distribution columns are requested), never
+            # the table
+            scores, hist = jax.device_get(dtree._device_score_all(
+                table_dev, flat.seg_tab_dev, flat.attr_dev, flat.nseg_dev,
+                jnp.float32(parent_info or 0.0), algorithm=p["algorithm"],
+                gmax=flat.gmax, chunk=flat.chunk,
+                has_parent=parent_info is not None, want_hist=out_distr))
+            lines = [emit_row(sp, scores[si, 0],
+                              hist[si, :, 0, :] if out_distr else None)
+                     for si, sp in enumerate(flat.splits)]
+        else:
+            table = np.asarray(table_dev)
+            for _a, chunk, scores, hist in dtree.iter_scored_splits(
+                    table, all_splits, p["algorithm"], split_chunk,
+                    parent_info=parent_info):
+                lines.extend(emit_row(sp, scores[si, 0], hist[si, :, 0, :])
+                             for si, sp in enumerate(chunk))
         write_output(output_path, lines)
         counters.set("Records", "Processed", ds.num_rows)
         counters.set("Splits", "Evaluated", len(lines))
@@ -159,8 +190,9 @@ class DataPartitioner(Job):
         is_cat = [schema.field_by_ordinal(o).is_categorical
                   for o in ds.binned_ordinals]
         a = ds.binned_ordinals.index(attr_ord)
-        all_splits = dtree.generate_candidate_splits(
-            ds, _tree_params(conf)["max_split"], is_cat, attrs=[a])
+        p = _tree_params(conf)
+        all_splits = dtree.candidate_splits_for(
+            ds, p["split_search"], p["max_split"], is_cat, attrs=[a])
         sp = next((s for s in all_splits[a] if s.key == key), None)
         if sp is None:
             raise ValueError(f"split key {key!r} not found for attribute {attr_ord}")
@@ -205,6 +237,7 @@ class DecisionTreeBuilder(Job):
             min_node_size=conf.get_int("min.node.size", 32),
             seed=conf.get_int("seed", 0),
             mesh=self.auto_mesh(conf),
+            selection=p["selection"], split_search=p["split_search"],
         )
         model = trainer.fit(ds, is_cat)
         write_output(output_path, [model.to_string(),

@@ -32,6 +32,29 @@ STATS: Dict[str, Callable] = {
 }
 
 
+def _einsum_key_prefix(f: int, b_dst: int, pairs) -> str:
+    """Einsum-path accumulator key prefix (chunk keys are
+    ``"<prefix>:<chunk_start>"``), qualifying every key with a fingerprint
+    of the pair list: num binned features, destination cardinality, pair
+    count, and a digest of the actual (src, dst) index pairs — count
+    alone would collide for different same-sized selections, e.g.
+    ``src=[0,1,2]`` vs ``src=[3,4,5]``.  A checkpoint restored after the
+    attribute lists change would otherwise carry same-named keys whose
+    [P_chunk, B, B] partials are shape-compatible by accident yet count
+    DIFFERENT pairs — the resume gate in ``fit`` rejects it loudly
+    instead of silently summing incompatible partials.  Computed ONCE per
+    fit (the digest is fit-invariant; hashing the pair list per chunk key
+    would be pure hot-loop churn on wide schemas)."""
+    import hashlib
+
+    # canonicalize to python ints: repr of numpy scalars is type- and
+    # version-dependent ('np.int64(3)' under numpy 2), and src/dst often
+    # arrive as numpy arrays — the digest must depend on values only
+    canon = repr([(int(a), int(b)) for a, b in pairs])
+    digest = hashlib.blake2s(canon.encode(), digest_size=4).hexdigest()
+    return f"c{f}x{b_dst}p{len(pairs)}h{digest}"
+
+
 @dataclass
 class CorrelationResult:
     pairs: List[Tuple[int, int]]         # (src binned-index, dst binned-index)
@@ -113,17 +136,21 @@ class CategoricalCorrelation:
         # preferring one key family would discard every chunk accumulated
         # under the other (pre- or post-resume) and corrupt the statistics
         gk = pallas_hist.g_key(f, b, n_cls) if fast else None
+        ek = None if fast else _einsum_key_prefix(f, b_dst, pairs)
         if accumulator is not None:
             expected = {gk} if fast else {
-                f"c{s}" for s in range(0, len(pairs), self.pair_chunk)}
+                f"{ek}:{s}"
+                for s in range(0, len(pairs), self.pair_chunk)}
             stale = [k for k in accumulator.names() if k not in expected]
             if stale:
                 raise ValueError(
                     f"restored correlation accumulator holds keys {stale} "
                     f"incompatible with this run's count path "
-                    f"({'kernel ' + gk if fast else 'einsum'}); the snapshot "
-                    f"was written under a different device/kernel layout — "
-                    f"clear the checkpoint directory and re-run")
+                    f"({'kernel ' + gk if fast else 'einsum'}) or pair "
+                    f"list (F={f}, B_dst={b_dst}, {len(pairs)} pairs); the "
+                    f"snapshot was written under a different device/kernel "
+                    f"layout or attribute selection — clear the checkpoint "
+                    f"directory and re-run")
         for ds in chunks:
             codes, lab = maybe_shard_batch(self.mesh, ds.codes, ds.labels)
             if fast:
@@ -140,7 +167,7 @@ class CategoricalCorrelation:
                     cj = jnp.broadcast_to(lab[:, None], (codes.shape[0], len(sl)))
                 else:
                     cj = codes[:, [p[1] for p in sl]]
-                acc.add(f"c{s}", agg.pair_counts(ci, cj, b_dst))
+                acc.add(f"{ek}:{s}", agg.pair_counts(ci, cj, b_dst))
         if fast and gk in acc and against_class:
             fbc, _ = pallas_hist.counts_from_cooc(
                 acc.get(gk), f, b, n_cls, np.zeros(0, np.int64),
@@ -154,8 +181,9 @@ class CategoricalCorrelation:
                 np.array([p[1] for p in pairs], np.int64))
             cont = pair4[:, :, :, 0]                     # [P, B, B]
         elif pairs:
-            cont = np.concatenate([acc.get(f"c{s}")
-                                   for s in range(0, len(pairs), self.pair_chunk)])
+            cont = np.concatenate([
+                acc.get(f"{ek}:{s}")
+                for s in range(0, len(pairs), self.pair_chunk)])
         else:
             cont = np.zeros((0, b_dst, b_dst), np.int64)
         # statistic over the true (rows, cols) support of each pair; tiny

@@ -156,6 +156,29 @@ def generate_candidate_splits(
     return result
 
 
+def candidate_splits_for(
+    ds: EncodedDataset,
+    split_search: str,
+    max_split: int,
+    is_categorical: Optional[Sequence[bool]],
+    max_candidates_per_attr: int = 256,
+    attrs: Optional[Sequence[int]] = None,
+) -> Dict[int, List[CandidateSplit]]:
+    """The ONE mapping from ``split_search`` to a candidate family, shared
+    by DecisionTree.fit and the ClassPartitionGenerator / DataPartitioner
+    jobs — the same enumeration must produce the same keys everywhere or
+    DataPartitioner's split-key lookup breaks.  ``binary`` = one sorted
+    threshold on the bin-code grid for EVERY attribute (ordinal
+    semantics, sklearn's candidate family); ``exhaustive`` = the
+    reference's multi-way numeric/categorical enumeration."""
+    if split_search == "binary":
+        return generate_candidate_splits(
+            ds, 2, [False] * ds.num_binned, max_candidates_per_attr,
+            attrs=attrs)
+    return generate_candidate_splits(
+        ds, max_split, is_categorical, max_candidates_per_attr, attrs=attrs)
+
+
 # ---------------------------------------------------------------------------
 # split evaluation on device
 # ---------------------------------------------------------------------------
@@ -281,12 +304,31 @@ def split_histograms_from_table(table_a: np.ndarray,
     return np.einsum("sgb,bkc->sgkc", m, table_a)
 
 
+def _chunk_seg_mask(chunk: Sequence["CandidateSplit"], gmax: int) -> np.ndarray:
+    """[S, G] validity mask: segment g is real for split s iff
+    g < num_segments — shared by the host and device scoring paths so
+    padded segments never leak into a score (classConfidenceRatio is the
+    one algorithm not zero-count-invariant: an empty padded segment would
+    contribute confidence (0+1)/(0+1) = 1, making the score depend on
+    which splits happened to share a chunk/padding width)."""
+    nsegs = np.array([sp.num_segments for sp in chunk], np.int32)
+    return nsegs[:, None] > np.arange(gmax, dtype=np.int32)[None, :]
+
+
 def iter_scored_splits(table: np.ndarray, all_splits, algorithm: str,
                        split_chunk: int, attrs=None, parent_info=None):
     """Yield (attr, chunk, scores [S, K], hist [S, G, K, C]) per candidate
     split chunk, all derived from the level table on the LOCAL host
-    backend — the single scoring pipeline behind both DecisionTree.fit
-    and the ClassPartitionGenerator job."""
+    backend — the host reference pipeline behind ``selection="host"`` and
+    the device-selection equivalence tests.
+
+    Scores go through the JITTED ``split_scores`` (``_split_scores_jit``):
+    the compiled graph rounds identically whether it runs standalone here
+    or fused inside the device-selection dispatch, and it is invariant to
+    chunk shape and zero-segment padding (measured: 0 mismatching bits
+    across all four algorithms on the retarget candidate set) — eager
+    per-op scoring differs from the fused form in the last float bit,
+    which would break the byte-identical-tree contract between paths."""
     with info.on_host():
         for a in (attrs if attrs is not None else sorted(all_splits)):
             splits = all_splits[a]
@@ -296,14 +338,16 @@ def iter_scored_splits(table: np.ndarray, all_splits, algorithm: str,
                 chunk = splits[s0:s0 + split_chunk]
                 gmax = max(sp.num_segments for sp in chunk)
                 hist = split_histograms_from_table(table[a], chunk, gmax)
-                scores = np.asarray(split_scores(
+                scores = np.asarray(_split_scores_jit(
                     jnp.asarray(hist, jnp.float32), algorithm,
-                    parent_info=parent_info))
+                    parent_info=parent_info,
+                    seg_mask=jnp.asarray(_chunk_seg_mask(chunk, gmax))))
                 yield a, chunk, scores, hist
 
 
 def split_scores(hist: jax.Array, algorithm: str,
-                 parent_info: Optional[float] = None) -> jax.Array:
+                 parent_info: Optional[float] = None,
+                 seg_mask: Optional[jax.Array] = None) -> jax.Array:
     """hist [S, G, K, C] → score [S, K]; higher is better for every algorithm.
 
     entropy/giniIndex → gain ratio: (parent impurity − weighted child
@@ -316,6 +360,15 @@ def split_scores(hist: jax.Array, algorithm: str,
     (binary class, :228-284). classConfidenceRatio → entropy of the
     normalized per-segment class-confidence ratios (:291-339); lower entropy
     = more skew = better, so the score is negated entropy.
+
+    ``seg_mask`` [S, G] marks which segments are real for each split (the
+    histogram may be zero-padded to a common G).  entropy / gini /
+    hellinger are bit-invariant to all-zero padded segments (each
+    contributes an exact +0.0 term), so the mask only gates
+    classConfidenceRatio, whose +1 Laplace smoothing would otherwise count
+    phantom segments.  With the mask, scores are independent of chunk
+    composition and padding width — the property the device and host
+    selection paths rely on for byte-identical trees.
     """
     h = hist.astype(jnp.float32)                          # [S, G, K, C]
     seg_tot = h.sum(-1)                                   # [S, G, K]
@@ -338,9 +391,161 @@ def split_scores(hist: jax.Array, algorithm: str,
         return jnp.sqrt(jnp.maximum(d.sum(1), 0.0)) / jnp.sqrt(2.0)  # [S, K]
     if algorithm == "classConfidenceRatio":
         conf = (h[..., 0] + 1.0) / (h[..., 1] + 1.0)                 # [S, G, K]
+        if seg_mask is not None:
+            conf = jnp.where(seg_mask[:, :, None], conf, 0.0)
         ratio = conf / jnp.maximum(conf.sum(1, keepdims=True), 1e-9)
         return -info.entropy(jnp.swapaxes(ratio, 1, 2), axis=-1)
     raise ValueError(f"unknown algorithm {algorithm!r}; known: {ALGORITHMS}")
+
+
+# the one compiled scoring graph shared by the host pipeline and (inlined)
+# the device-selection dispatch — see iter_scored_splits on why eager
+# scoring is not bit-compatible with the fused form
+_split_scores_jit = jax.jit(split_scores, static_argnames=("algorithm",))
+
+
+# ---------------------------------------------------------------------------
+# device-resident split selection
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FlatSplits:
+    """Per-fit static candidate-split metadata, compiled once into padded
+    device arrays so the per-level selection dispatch is jit-stable across
+    levels (only the frontier width K varies).
+
+    ``splits`` holds the CandidateSplit objects in device flat order —
+    ascending attribute, then enumeration order within the attribute (the
+    same order the host path iterates, so argmax/top-k tie-breaking by
+    lowest flat index reproduces the host's stable sort).  The arrays are
+    padded to a multiple of ``chunk`` rows; pad rows have ``valid`` False
+    and are force-masked to −inf before selection.
+    """
+
+    splits: List[CandidateSplit]
+    attr_of: np.ndarray                  # [S_pad] int32 (host copy, for masks)
+    valid: np.ndarray                    # [S_pad] bool — False on pad rows
+    gmax: int
+    chunk: int
+    seg_tab_dev: jax.Array               # [S_pad, B] int32
+    attr_dev: jax.Array                  # [S_pad] int32
+    nseg_dev: jax.Array                  # [S_pad] int32
+
+    @property
+    def num_real(self) -> int:
+        return len(self.splits)
+
+    def allow_vector(self, attrs: Sequence[int]) -> np.ndarray:
+        """[S_pad] bool — splits whose attribute the level's strategy
+        selected (randomK / userSpecified), excluding pad rows.  A tiny
+        per-level host→device upload; everything else is fit-static."""
+        return self.valid & np.isin(
+            self.attr_of, np.asarray(list(attrs), np.int32))
+
+
+def flatten_splits(all_splits: Dict[int, List[CandidateSplit]],
+                   max_bins: int, split_chunk: int) -> FlatSplits:
+    """Compile the per-attr candidate dict into FlatSplits device arrays."""
+    flat = [sp for a in sorted(all_splits) for sp in all_splits[a]]
+    s = len(flat)
+    gmax = max([sp.num_segments for sp in flat] or [1])
+    chunk = max(1, min(split_chunk, max(s, 1)))
+    s_pad = max(-(-s // chunk) * chunk, chunk)
+    seg_tab = np.zeros((s_pad, max_bins), np.int32)
+    attr_of = np.zeros(s_pad, np.int32)
+    nseg = np.ones(s_pad, np.int32)
+    valid = np.zeros(s_pad, bool)
+    for i, sp in enumerate(flat):
+        seg_tab[i] = sp.seg_of_bin
+        attr_of[i] = sp.attr
+        nseg[i] = sp.num_segments
+        valid[i] = True
+    return FlatSplits(
+        splits=flat, attr_of=attr_of, valid=valid, gmax=gmax, chunk=chunk,
+        seg_tab_dev=jnp.asarray(seg_tab), attr_dev=jnp.asarray(attr_of),
+        nseg_dev=jnp.asarray(nseg))
+
+
+def _scored_chunks(table: jax.Array, seg_tab: jax.Array, attr_of: jax.Array,
+                   nseg: jax.Array, algorithm: str, gmax: int, chunk: int,
+                   parent_info=None, want_hist: bool = False):
+    """Score every padded candidate split against the device level table in
+    ``chunk``-sized blocks under ``lax.map`` (bounds the [s, B, K, C]
+    gather working set).  Returns scores [S_pad, K] and, when
+    ``want_hist``, the [S_pad, G, K, C] int32 histograms."""
+    s_pad, b = seg_tab.shape
+    nc = s_pad // chunk
+    grange = jnp.arange(gmax, dtype=jnp.int32)
+
+    def block(args):
+        st, ao, ns = args                                   # [s,B] [s] [s]
+        h = info.split_segment_histograms(table, st, ao, gmax)
+        mask = grange[None, :] < ns[:, None]                # [s, G]
+        sc = split_scores(h.astype(jnp.float32), algorithm,
+                          parent_info=parent_info, seg_mask=mask)
+        return (sc, h) if want_hist else (sc,)
+
+    out = jax.lax.map(block, (seg_tab.reshape(nc, chunk, b),
+                              attr_of.reshape(nc, chunk),
+                              nseg.reshape(nc, chunk)))
+    k = table.shape[2]
+    scores = out[0].reshape(s_pad, k)
+    if want_hist:
+        return scores, out[1].reshape(s_pad, gmax, k, table.shape[3])
+    return scores, None
+
+
+@functools.partial(jax.jit, static_argnames=("algorithm", "gmax", "top_k",
+                                             "chunk"))
+def _device_select_splits(table: jax.Array, seg_tab: jax.Array,
+                          attr_of: jax.Array, nseg: jax.Array,
+                          allow: jax.Array, *, algorithm: str, gmax: int,
+                          top_k: int, chunk: int):
+    """Device-resident split selection for one frontier level: build every
+    candidate's segment histogram from the on-device [F, B, K, C] table
+    (``info.split_segment_histograms`` — a device einsum, not a host numpy
+    pass), score with the ``split_scores`` kernels, and take the top-k
+    winners PER FRONTIER NODE on device.  The host fetches only the
+    KB-sized descriptors (score, flat split index, [G, C] winner
+    histogram) — replacing the full-table fetch + host fold whose ~100 ms
+    tunnel RTT per level dominated induction wall time (BENCH_r05
+    ``families.tree``).
+
+    Returns (vals [K, P], idx [K, P], hist [K, P, G, C] int32), P = top_k,
+    sorted best-first; ``lax.top_k`` breaks ties toward the lowest flat
+    index, matching the host path's stable sort over its iteration order.
+    Disallowed (strategy-masked) and pad candidates come back as −inf.
+    """
+    scores, _ = _scored_chunks(table, seg_tab, attr_of, nseg,
+                               algorithm, gmax, chunk)
+    scores = jnp.where(allow[:, None] & ~jnp.isnan(scores), scores, -jnp.inf)
+    vals, idx = jax.lax.top_k(scores.T, top_k)              # [K, P] each
+    k = table.shape[2]
+    grange = jnp.arange(gmax, dtype=jnp.int32)
+    tt = jnp.transpose(table, (2, 0, 1, 3))                 # [K, F, B, C]
+    w_ta = tt[jnp.arange(k)[:, None], attr_of[idx]]         # [K, P, B, C]
+    w_m = (seg_tab[idx][:, :, None, :] ==
+           grange[None, None, :, None]).astype(jnp.int32)   # [K, P, G, B]
+    w_hist = jnp.einsum("kpgb,kpbc->kpgc", w_m, w_ta)       # int32
+    return vals, idx, w_hist
+
+
+@functools.partial(jax.jit, static_argnames=("algorithm", "gmax", "chunk",
+                                             "has_parent", "want_hist"))
+def _device_score_all(table: jax.Array, seg_tab: jax.Array,
+                      attr_of: jax.Array, nseg: jax.Array, parent_info,
+                      *, algorithm: str, gmax: int, chunk: int,
+                      has_parent: bool, want_hist: bool = False):
+    """Score EVERY candidate split on device and return (scores [S_pad, K],
+    hist [S_pad, G, K, C] or None) — the batched entry behind the
+    ClassPartitionGenerator job, whose contract is the full scored list
+    rather than a per-node winner.  One dispatch; the fetch is the
+    [S, K] score sheet (plus, only when ``want_hist``, the small
+    histograms for the optional segment-distribution output columns),
+    never the [F, B, K, C] table."""
+    return _scored_chunks(table, seg_tab, attr_of, nseg, algorithm, gmax,
+                          chunk, parent_info=parent_info if has_parent
+                          else None, want_hist=want_hist)
 
 
 # ---------------------------------------------------------------------------
@@ -473,6 +678,37 @@ class DecisionTree:
     all|userSpecified|randomK (split.attribute.selection.strategy),
     ``top_n`` random-from-top-N split selection (custom.base.attribute.ordinals /
     DataPartitioner.java:181-185).
+
+    ``selection`` picks where per-level split selection runs:
+
+    - ``"device"`` (default) — candidate histograms, scores and the
+      per-node top-k winner all run on device against the resident level
+      table; the host fetches only KB-sized chosen-split descriptors per
+      level.  One dispatch + one small fetch per level, composing with the
+      device-resident node vector (``_apply_level_partition``).
+    - ``"host"`` — the prior pipeline: fetch the whole [F, B, K, C] table
+      and fold it on host (``iter_scored_splits``).  Kept as the
+      equivalence oracle; both paths grow byte-identical trees (asserted
+      in tests across all four algorithms).  For tie-breaks to agree, the
+      device flat order assumes ascending-attribute iteration; an
+      unsorted ``user_attrs`` list can differ on exact score ties only.
+      Byte-identity is a same-backend guarantee (the tier-1 equivalence
+      tests run both paths on CPU): on a TPU the device path scores in
+      TPU f32 while the host oracle scores on the local CPU backend, so
+      candidates whose true scores differ by under ~1 ulp may pick
+      differently there — exact ties still agree (lowest flat index).
+
+    ``split_search`` picks the candidate family:
+
+    - ``"exhaustive"`` (default) — the reference's multi-way search: all
+      increasing threshold sets for numeric fields and all set partitions
+      for categorical fields up to ``max_split`` groups
+      (ClassPartitionGenerator.java:280-432).
+    - ``"binary"`` — sorted-threshold binary splits only (every attribute
+      treated as ordinal over its bin codes, one threshold, two
+      segments) — the candidate family sklearn's DecisionTreeClassifier
+      searches over ordinal-encoded inputs, scored by the same kernels;
+      the apples-to-apples benchmarking mode.
     """
 
     def __init__(
@@ -490,9 +726,19 @@ class DecisionTree:
         split_chunk: int = 128,
         seed: int = 0,
         mesh=None,
+        selection: str = "device",
+        split_search: str = "exhaustive",
     ):
         if algorithm not in ALGORITHMS:
             raise ValueError(f"unknown algorithm {algorithm!r}; known: {ALGORITHMS}")
+        if selection not in ("device", "host"):
+            raise ValueError(
+                f"unknown selection {selection!r}; known: device, host")
+        if split_search not in ("exhaustive", "binary"):
+            raise ValueError(f"unknown split_search {split_search!r}; "
+                             "known: exhaustive, binary")
+        self.selection = selection
+        self.split_search = split_search
         self.algorithm = algorithm
         self.max_depth = max_depth
         self.min_node_size = min_node_size
@@ -541,8 +787,12 @@ class DecisionTree:
                      and pallas_hist.cross_applicable(
                          ds.num_binned, ds.max_bins, max(c, 1)))
         codes_t_dev = codes_dev.T if use_cross else None
-        all_splits = generate_candidate_splits(
-            ds, self.max_split, is_categorical, self.max_candidates_per_attr)
+        all_splits = candidate_splits_for(
+            ds, self.split_search, self.max_split, is_categorical,
+            self.max_candidates_per_attr)
+        flat = (flatten_splits(all_splits, ds.max_bins, self.split_chunk)
+                if self.selection == "device" else None)
+        use_device_sel = flat is not None and flat.num_real > 0
 
         root_counts = np.bincount(ds.labels, minlength=c).astype(np.float64)
         nodes: List[TreeNode] = [TreeNode(0, 0, root_counts)]
@@ -564,26 +814,47 @@ class DecisionTree:
                 remap[nid] = i
             remap_dev = jnp.asarray(remap)
             local_node_dev = _remap_nodes(node_dev, remap_dev)
-            # ONE device round trip per level: the [F, B, K, C] table; all
-            # candidate histograms and scores derive from it on host
+            # the [F, B, K, C] level table stays ON DEVICE; under device
+            # selection it is never fetched — only the chosen-split
+            # descriptors are
             if use_cross and pallas_hist.cross_applicable(
                     ds.num_binned, ds.max_bins, k * c):
-                table = np.asarray(_level_table_cross(
+                table_dev = _level_table_cross(
                     codes_t_dev, local_node_dev, labels_dev, k, c,
-                    ds.max_bins))
+                    ds.max_bins)
             else:
-                table = np.asarray(node_bin_class_counts(
-                    codes_dev, local_node_dev, labels_dev, k, c, ds.max_bins))
+                table_dev = node_bin_class_counts(
+                    codes_dev, local_node_dev, labels_dev, k, c, ds.max_bins)
 
+            attrs_lv = self._attrs_for_node(rng, ds.num_binned)
             best_per_node: List[List[Tuple[float, CandidateSplit, np.ndarray]]] = [
                 [] for _ in range(k)]
-            for _a, chunk, scores, hist in iter_scored_splits(
-                    table, all_splits, self.algorithm, self.split_chunk,
-                    attrs=self._attrs_for_node(rng, ds.num_binned)):
-                for si, sp in enumerate(chunk):
-                    for ki in range(k):
-                        best_per_node[ki].append((float(scores[si, ki]), sp,
-                                                  hist[si, :, ki, :]))
+            if use_device_sel:
+                # one dispatch (histograms + scores + per-node top-k on
+                # device), one KB-sized fetch
+                top_k = min(max(self.top_n, 1), flat.seg_tab_dev.shape[0])
+                vals, idx, whist = jax.device_get(_device_select_splits(
+                    table_dev, flat.seg_tab_dev, flat.attr_dev,
+                    flat.nseg_dev, jnp.asarray(flat.allow_vector(attrs_lv)),
+                    algorithm=self.algorithm, gmax=flat.gmax, top_k=top_k,
+                    chunk=flat.chunk))
+                for ki in range(k):
+                    for p in range(top_k):
+                        s = float(vals[ki, p])
+                        if s == -np.inf:        # pad / strategy-masked slot
+                            continue
+                        best_per_node[ki].append(
+                            (s, flat.splits[int(idx[ki, p])], whist[ki, p]))
+            else:
+                table = np.asarray(table_dev)
+                for _a, chunk, scores, hist in iter_scored_splits(
+                        table, all_splits, self.algorithm, self.split_chunk,
+                        attrs=attrs_lv):
+                    for si, sp in enumerate(chunk):
+                        for ki in range(k):
+                            best_per_node[ki].append(
+                                (float(scores[si, ki]), sp,
+                                 hist[si, :, ki, :]))
             # select per node: best or random among top_n
             new_frontier: List[int] = []
             attr_arr = np.zeros(k, np.int32)

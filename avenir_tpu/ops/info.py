@@ -47,6 +47,26 @@ def _safe_log(x: jax.Array) -> jax.Array:
     return jnp.log(jnp.where(x > 0, x, 1.0))
 
 
+def split_segment_histograms(table: jax.Array, seg_tab: jax.Array,
+                             attr_of: jax.Array, gmax: int) -> jax.Array:
+    """Batched device scoring entry for tree induction: the [F, B, K, C]
+    level table plus flat candidate-split metadata (``seg_tab`` [S, B]
+    bin→segment maps, ``attr_of`` [S] owning attribute per split) → the
+    [S, G, K, C] per-split segment×class histograms, as ONE device einsum
+    over the split axis — no N-dependent work and no host numpy pass.
+
+    The int32 contraction keeps counts exact (the one-hot segment mask
+    times integer counts), so the result is bit-identical to the host
+    :func:`avenir_tpu.models.tree.split_histograms_from_table` fold it
+    replaces on the device path.  Segments ≥ a split's true segment count
+    come out all-zero; statistics downstream must be zero-count-invariant
+    (or masked — see ``split_scores``'s ``seg_mask``).
+    """
+    grange = jnp.arange(gmax, dtype=jnp.int32)
+    m = (seg_tab[:, None, :] == grange[None, :, None]).astype(jnp.int32)
+    return jnp.einsum("sgb,sbkc->sgkc", m, table[attr_of])
+
+
 def normalize(counts: jax.Array, axis=None) -> jax.Array:
     """Counts → probabilities along ``axis`` (all trailing mass if None)."""
     total = jnp.sum(counts, axis=axis, keepdims=axis is not None)

@@ -127,9 +127,15 @@ def main():
     # ALL recorded passes are reported and the headline is the MEDIAN: the
     # tunnel's dispatch timing jitters run-to-run by tens of percent
     # (BASELINE.md), so the per-pass list documents the spread and the
-    # median resists both tails.
+    # median resists both tails.  A fresh canary runs before EACH pass
+    # (round-6): the r05 artifact's 158–377M rows/s within-run spread was
+    # unattributable with only one pre-run canary — the per-pass list
+    # separates rig contention (canary inflates with the slow passes)
+    # from kernel regression (canary flat while passes sag).
     passes = []
+    canary_per_pass = []
     for _ in range(5):
+        canary_per_pass.append(matmul_canary_ms())
         rate, out = timed_pass()
         passes.append(rate)
     rows_per_sec = float(np.median(passes))
@@ -170,6 +176,7 @@ def main():
         "count_path": "pallas_cooc_int8_mxu" if kernel_path else "einsum",
         "finalize_ms": round(finalize_ms, 3),
         "canary_matmul_4096_bf16_ms": round(canary_ms, 2),
+        "canary_per_pass_ms": [round(c, 2) for c in canary_per_pass],
     }
     line.update(mfu_fields(
         bytes_moved=n_chunks * chunk * bytes_per_row,
@@ -194,10 +201,12 @@ def main():
                         "canary_matmul_4096_bf16_ms", "canary_knn_dot_ms")
                        if kf in knn}
 
-        # per-family driver numbers (round-4 item 5): tree/viterbi/lr/cramer
-        # at reduced shapes with measured single-core baselines, so
-        # BENCH_r*.json — not BASELINE.md prose — carries every family's
-        # value AND its vs_baseline ratio (same chained-sync discipline)
+        # per-family driver numbers (round-4 item 5): tree (exhaustive),
+        # tree_binary (sklearn-comparable binary-threshold mode, round 6),
+        # viterbi/lr/cramer at reduced shapes with measured single-core
+        # baselines, so BENCH_r*.json — not BASELINE.md prose — carries
+        # every family's value AND its vs_baseline ratio (same
+        # chained-sync discipline); tree rows tag their selection path
         from benchmarks.family_bench import families_summary
         line["families"] = families_summary(passes=2)
     print(json.dumps(line))

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Per-level split-selection transport probe — makes the tree family's RTT
+claim a reproducible artifact instead of prose.
+
+The round-5 verdict root-caused tree induction's sub-baseline throughput
+(`BENCH_r05.json` `families.tree.vs_baseline: 0.21`) to per-level host
+round-trips: the host fetched the whole [F, B, K, C] level table
+(`selection="host"`) and folded candidate splits there, paying the
+~100 ms tunnel RTT once per level.  Device-resident selection
+(`selection="device"`, round 6) keeps histograms, scoring and the
+per-node top-k on device and fetches only KB-sized chosen-split
+descriptors.  This probe measures BOTH at the driver shape
+(family_bench's reduced 1M-row retarget fit) and, separately, the two
+per-level transports in isolation:
+
+- ``table_fetch_ms``  — wall time of ``np.asarray`` on the root level
+  table (the host path's per-level fetch; scales with F·B·K·C and RTT);
+- ``select_fetch_ms`` — wall time of the device-selection dispatch + its
+  descriptor fetch for the same table (what replaces it).
+
+Sync discipline as everywhere on this rig: a host fetch is the only
+reliable barrier, so each timed region ends in one (BASELINE.md
+"Timing methodology").  Run:
+
+  python -m benchmarks.tree_rtt_probe [--rows 1000000] [--passes 3]
+
+Prints ONE JSON line.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def measure(rows: int = 1_000_000, passes: int = 3,
+            max_depth: int = 4) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from avenir_tpu.models import tree as dtree
+    from benchmarks.family_bench import _tree_data
+
+    ds, is_cat = _tree_data(rows)
+
+    def fit_rate(selection: str):
+        builder = dtree.DecisionTree(algorithm="entropy", max_depth=max_depth,
+                                     max_split=3, selection=selection)
+        builder.fit(ds, is_categorical=is_cat)          # compile + warm
+        vals = []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            model = builder.fit(ds, is_categorical=is_cat)
+            vals.append(rows / (time.perf_counter() - t0))
+        return float(np.median(vals)), model
+
+    host_rate, model = fit_rate("host")
+    dev_rate, model_dev = fit_rate("device")
+    if model.to_string() != model_dev.to_string():      # paranoia, not timing
+        raise AssertionError("device/host selection trees diverged")
+
+    # isolate the two per-level transports on the root level table
+    all_splits = dtree.generate_candidate_splits(ds, 3, is_cat, 128)
+    flat = dtree.flatten_splits(all_splits, ds.max_bins, 128)
+    c = ds.num_classes
+    table_dev = dtree.node_bin_class_counts(
+        jnp.asarray(ds.codes), jnp.zeros(ds.num_rows, jnp.int32),
+        jnp.asarray(ds.labels), 1, c, ds.max_bins)
+    allow = jnp.asarray(flat.allow_vector(range(ds.num_binned)))
+    np.asarray(table_dev)                               # warm the fetch path
+    jax.device_get(dtree._device_select_splits(
+        table_dev, flat.seg_tab_dev, flat.attr_dev, flat.nseg_dev, allow,
+        algorithm="entropy", gmax=flat.gmax, top_k=1, chunk=flat.chunk))
+
+    def med_ms(fn):
+        vals = []
+        for _ in range(max(passes, 3)):
+            t0 = time.perf_counter()
+            fn()
+            vals.append((time.perf_counter() - t0) * 1e3)
+        return round(float(np.median(vals)), 3)
+
+    table_fetch_ms = med_ms(lambda: np.asarray(table_dev))
+    select_fetch_ms = med_ms(lambda: jax.device_get(
+        dtree._device_select_splits(
+            table_dev, flat.seg_tab_dev, flat.attr_dev, flat.nseg_dev,
+            allow, algorithm="entropy", gmax=flat.gmax, top_k=1,
+            chunk=flat.chunk)))
+
+    f, b = ds.num_binned, ds.max_bins
+    return {
+        "metric": "tree_split_selection_rtt_probe",
+        "n_rows": rows, "max_depth": max_depth,
+        "table_shape_fbkc": [f, b, 1, c],
+        "table_bytes": int(f * b * 1 * c * 4),
+        "descriptor_bytes": int(4 + 4 + flat.gmax * c * 4),   # per node·pick
+        "host_selection_rows_per_sec": round(host_rate, 1),
+        "device_selection_rows_per_sec": round(dev_rate, 1),
+        "device_vs_host": round(dev_rate / host_rate, 2),
+        "table_fetch_ms": table_fetch_ms,
+        "select_dispatch_plus_fetch_ms": select_fetch_ms,
+        "note": "table_fetch_ms is what selection=host pays PER LEVEL on "
+                "top of scoring; select_dispatch_plus_fetch_ms replaces "
+                "it (device histograms+scores+top-k, KB descriptor fetch)",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--passes", type=int, default=3)
+    ap.add_argument("--max-depth", type=int, default=4)
+    args = ap.parse_args()
+    print(json.dumps(measure(args.rows, args.passes, args.max_depth)))
+
+
+if __name__ == "__main__":
+    main()

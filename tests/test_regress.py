@@ -146,3 +146,54 @@ def test_lr_mesh_matches_single_device(rng):
     assert m_mesh.iterations == m_single.iterations
     np.testing.assert_allclose(m_mesh.weights, m_single.weights,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_broadcast_resume_reconstruction_and_error_paths(monkeypatch):
+    """The multi-process resume handshake, unit-tested with a stubbed
+    collective (real multi-process collectives run in
+    test_multiprocess.py's worker suite): the writer's history stack
+    reconstructs bitwise on every process, a peer with no contribution
+    gets None, a writer-side read error re-raises through the collective,
+    and a ragged history is converted to the error payload INSTEAD of
+    raising before the collective (which would strand peers in the
+    allgather)."""
+    import jax
+
+    from avenir_tpu.jobs.regress import LogisticRegressionJob
+    from avenir_tpu.models import logistic as mlr
+    from avenir_tpu.parallel import mesh as pmesh
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    calls = []
+
+    def fake_collective(state):           # identity fold: 1 contributor
+        calls.append(set(state))
+        return {k: np.asarray(v) for k, v in state.items()}
+
+    monkeypatch.setattr(pmesh, "all_process_sum_state", fake_collective)
+
+    hist = [np.array([0.125, -3.5]), np.array([0.25, 7.0])]
+    resume = mlr.LogisticRegressionModel(weights=hist[-1], history=hist,
+                                         iterations=2)
+    out = LogisticRegressionJob._broadcast_resume(resume)
+    np.testing.assert_array_equal(np.stack(out.history), np.stack(hist))
+    np.testing.assert_array_equal(out.weights, hist[-1])
+    assert out.iterations == 2
+
+    # peer leg: nothing contributed, collective still entered, None back
+    assert LogisticRegressionJob._broadcast_resume(None) is None
+
+    # writer read error re-raises (after the collective ran)
+    with pytest.raises(ValueError, match="resume failed"):
+        LogisticRegressionJob._broadcast_resume(None, "ValueError: boom")
+
+    # ragged history: np.stack failure routes through the error payload
+    ragged = mlr.LogisticRegressionModel(
+        weights=np.zeros(2), history=[np.zeros(2), np.zeros(3)],
+        iterations=2)
+    with pytest.raises(ValueError, match="resume failed"):
+        LogisticRegressionJob._broadcast_resume(ragged)
+    # every leg entered exactly one collective — the sequence alignment
+    # the per-iteration merges depend on
+    assert calls == [{"lr_resume_hist"}, set(),
+                     {"lr_resume_error"}, {"lr_resume_error"}]

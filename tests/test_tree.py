@@ -124,6 +124,69 @@ def test_tree_vs_sklearn_accuracy(retarget):
     assert ours >= theirs - 0.03, (ours, theirs)
 
 
+def test_device_selection_matches_host_all_algorithms(retarget):
+    """Device-resident split selection (histograms + scores + per-node
+    top-k on device, KB fetch) must pick byte-identical splits — tree
+    JSON equal, scores included — to the host iter_scored_splits fold,
+    for every split algorithm."""
+    _, _, ds, is_cat = retarget
+    for algo in dtree.ALGORITHMS:
+        kw = dict(algorithm=algo, max_depth=3, max_split=3,
+                  max_candidates_per_attr=300, min_node_size=64)
+        m_dev = dtree.DecisionTree(selection="device", **kw).fit(ds, is_cat)
+        m_host = dtree.DecisionTree(selection="host", **kw).fit(ds, is_cat)
+        assert m_dev.to_string() == m_host.to_string(), algo
+
+
+def test_device_selection_matches_host_strategies(retarget):
+    """Equivalence must also hold when the rng is consumed (randomK per
+    level, random-from-top-N picks) and in binary search mode — both
+    paths must draw the identical random sequence."""
+    _, _, ds, is_cat = retarget
+    for kw in (dict(attr_strategy="randomK", random_k=2, top_n=2, seed=7,
+                    max_depth=3),
+               dict(top_n=3, max_depth=3),
+               dict(split_search="binary", max_depth=4)):
+        m_dev = dtree.DecisionTree(selection="device", **kw).fit(ds, is_cat)
+        m_host = dtree.DecisionTree(selection="host", **kw).fit(ds, is_cat)
+        assert m_dev.to_string() == m_host.to_string(), kw
+
+
+def test_binary_search_mode_structure(retarget):
+    """split_search='binary' must emit only two-segment numeric
+    (sorted-threshold) splits, for categorical attributes too."""
+    _, _, ds, is_cat = retarget
+    model = dtree.DecisionTree(split_search="binary", max_depth=4).fit(
+        ds, is_cat)
+    splits = [n.split for n in model.nodes if n.split is not None]
+    assert splits, "binary mode grew no splits"
+    for sp in splits:
+        assert sp.kind == "numeric" and sp.num_segments == 2, sp.key
+    with pytest.raises(ValueError):
+        dtree.DecisionTree(split_search="nope")
+    with pytest.raises(ValueError):
+        dtree.DecisionTree(selection="nope")
+
+
+def test_binary_mode_vs_sklearn_accuracy(retarget):
+    """Apples-to-apples accuracy parity: binary-threshold search on raw
+    ordinal codes (the same candidate family sklearn's
+    DecisionTreeClassifier scans, and the family_bench comparison
+    shape) must match sklearn's train accuracy within tolerance."""
+    sklearn_tree = pytest.importorskip("sklearn.tree")
+    _, _, ds, is_cat = retarget
+    model = dtree.DecisionTree(algorithm="giniIndex", max_depth=4,
+                               split_search="binary",
+                               min_node_size=16).fit(ds, is_cat)
+    pred, _, _, _ = dtree.DecisionTree().predict(model, ds)
+    ours = (pred == ds.labels).mean()
+    sk = sklearn_tree.DecisionTreeClassifier(max_depth=4, random_state=0)
+    x = np.asarray(ds.codes, np.float32)
+    sk.fit(x, ds.labels)
+    theirs = sk.score(x, ds.labels)
+    assert ours >= theirs - 0.03, (ours, theirs)
+
+
 def test_attr_strategies(retarget):
     _, _, ds, is_cat = retarget
     m_user = dtree.DecisionTree(attr_strategy="userSpecified", user_attrs=[1],
@@ -288,6 +351,32 @@ def test_class_partition_generator_at_root(tmp_path):
     p = np.mean(labels == "Y")
     expected = -(p * np.log(p) + (1 - p) * np.log(1 - p))
     np.testing.assert_allclose(stat, expected, rtol=1e-4)
+
+
+def test_class_partition_generator_device_matches_host(tmp_path):
+    """The job path also routes through the batched device scoring: the
+    emitted split file (scores formatted to 6 decimals, optional segment
+    distributions) must be line-identical to the host pipeline's."""
+    import json
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    rows = generate_retarget(2000, seed=6)
+    write_csv(str(tmp_path / "d.csv"), rows)
+    (tmp_path / "s.json").write_text(json.dumps(RETARGET_SCHEMA_JSON))
+    base = {"feature.schema.file.path": str(tmp_path / "s.json"),
+            "split.algorithm": "entropy", "max.split": "3",
+            "output.split.prob": "true", "parent.info": "0.61"}
+    get_job("ClassPartitionGenerator").run(
+        JobConfig(base), str(tmp_path / "d.csv"), str(tmp_path / "dev"))
+    get_job("ClassPartitionGenerator").run(
+        JobConfig({**base, "split.selection.path": "host"}),
+        str(tmp_path / "d.csv"), str(tmp_path / "host"))
+    dev_lines = read_lines(str(tmp_path / "dev"))
+    host_lines = read_lines(str(tmp_path / "host"))
+    assert dev_lines and dev_lines == host_lines
 
 
 def test_disease_rule_mining_recovers_age_driver(tmp_path):
