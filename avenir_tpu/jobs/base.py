@@ -654,28 +654,48 @@ class StreamCheckpointer:
       cursor (also the CLI's ``--resume`` flag)
     - ``stream.fault.crash.after.chunks``: fault injection — raise after N
       consumed chunks (kill-and-resume testing, incl. the 100M-row proof)
+    - ``stream.run.id``: optional explicit run identity; defaults to a
+      fingerprint of the job's stable properties (volatile relaunch flags
+      — ``stream.resume``, ``stream.fault.*`` — excluded), so a crashed
+      run's relaunch carries the same identity
 
     The snapshot is {accumulator totals, cursor(file, offset, chunk),
     rows}; counts are integer (or order-stable float64) host totals, so a
     resumed run's model files are byte-identical to an uninterrupted one.
     On successful job completion :meth:`finish` removes the directory —
-    stale snapshots must never leak into a later, unrelated run."""
+    stale snapshots must never leak into a later, unrelated run.  In
+    multi-process mode each process subdirectory is tagged with the run id
+    (``RUN_TAG``), and the end-of-run sweep removes ONLY subdirectories of
+    the same run (e.g. a crashed relaunch of this job at a different
+    process count) — a concurrent job sharing the root under a different
+    run id keeps its live snapshots (round-5 advisor finding).  Two
+    concurrent runs with identical properties AND a shared root remain
+    indistinguishable; a checkpoint root is exclusive to one run identity."""
 
     def __init__(self, directory: str, interval_chunks: int = 8,
                  resume: bool = False, crash_after_chunks: int = 0,
-                 parent_dir: Optional[str] = None):
+                 parent_dir: Optional[str] = None, run_id: str = ""):
         from avenir_tpu.ops import agg
         from avenir_tpu.utils.checkpoint import CheckpointManager
 
         self.mgr = CheckpointManager(directory, keep=2)
         self.directory = directory
         self.parent_dir = parent_dir         # multi-process: shared root
+        self.run_id = run_id
         self.interval = max(int(interval_chunks), 1)
         self.crash_after = int(crash_after_chunks)
         self.accumulator = agg.Accumulator()
         self.base_rows = 0
         self.start: Optional[dict] = None      # cursor to resume from
         self._consumed = 0                     # chunks consumed THIS run
+        if parent_dir is not None and run_id:
+            # tag the process subdirectory with this run's identity so the
+            # sweep in finish() can tell our stale subdirs from a live
+            # concurrent job's (the id is conf-derived, hence stable across
+            # crash + relaunch — including at a different process count)
+            os.makedirs(directory, exist_ok=True)
+            with open(os.path.join(directory, "RUN_TAG"), "w") as fh:
+                fh.write(run_id)
         if resume:
             state = self.mgr.restore()
             if state is not None:
@@ -683,6 +703,26 @@ class StreamCheckpointer:
                 self.base_rows = int(state["rows"])
                 self.start = {k: state["cursor"][k]
                               for k in ("file", "offset", "chunk")}
+
+    @staticmethod
+    def run_id_from_conf(conf: JobConfig) -> str:
+        """The run's identity tag: ``stream.run.id`` when set, else a
+        fingerprint of the stable properties.  Volatile relaunch flags
+        (``stream.resume``, ``stream.fault.*``) are excluded so a crashed
+        run and its resume relaunch share the identity — the finish()
+        sweep may then reclaim the crashed run's subdirectories at ANY
+        process count, while a different job's live snapshots (different
+        properties → different id) are never touched."""
+        explicit = conf.get("stream.run.id")
+        if explicit:
+            return explicit
+        import hashlib
+
+        stable = sorted(
+            (k, v) for k, v in conf.props.items()
+            if k != "stream.resume" and not k.startswith("stream.fault."))
+        return hashlib.blake2s(repr(stable).encode(),
+                               digest_size=6).hexdigest()
 
     @classmethod
     def from_conf(cls, conf: JobConfig) -> Optional["StreamCheckpointer"]:
@@ -706,7 +746,8 @@ class StreamCheckpointer:
                    conf.get_int("stream.checkpoint.interval.chunks", 8),
                    conf.get_bool("stream.resume", False),
                    conf.get_int("stream.fault.crash.after.chunks", 0),
-                   parent_dir=parent)
+                   parent_dir=parent,
+                   run_id=cls.run_id_from_conf(conf))
 
     def chunk_done(self, cursor: dict, last: bool) -> None:
         """Called by the stream after the model has accumulated the chunk
@@ -726,21 +767,32 @@ class StreamCheckpointer:
                 f"stream.fault.crash.after.chunks={self.crash_after}: "
                 f"injected crash after chunk {cursor['chunk']}")
 
+    @staticmethod
+    def _read_tag(directory: str) -> Optional[str]:
+        try:
+            with open(os.path.join(directory, "RUN_TAG")) as fh:
+                return fh.read().strip()
+        except OSError:
+            return None
+
     def finish(self) -> None:
         """Remove this run's snapshots after a successful run.  Deletes only
         manager-owned ``step_*``/temp entries — never unrelated files a user
         may keep in the same (possibly shared) directory — and the directory
         itself only once it is empty.  In a multi-process run each process
         clears its own ``proc-*`` subdirectory; a successful finish also
-        sweeps snapshot subdirectories left by crashed runs at OTHER
-        process counts (``proc-N-of-M`` names are checkpoint-owned by
-        construction) — without the sweep, a stale cursor from an old
-        topology could be restored much later against changed input and
-        silently contribute mixed totals."""
+        sweeps snapshot subdirectories left by crashed runs OF THE SAME RUN
+        ID at other process counts (a stale cursor from an old topology
+        restored much later against changed input would silently contribute
+        mixed totals).  Subdirectories tagged with a DIFFERENT run id — a
+        concurrent job sharing the root — or with no tag at all are left
+        intact: destroying a live run's durability is strictly worse than
+        leaving a stale directory behind (round-5 advisor finding)."""
         import re
 
         from avenir_tpu.utils.checkpoint import CheckpointManager
 
+        self._remove_tag(self.directory)
         self.mgr.clear()
         root = self.parent_dir or self.directory
         try:
@@ -748,9 +800,19 @@ class StreamCheckpointer:
         except FileNotFoundError:
             return
         for name in names:
-            if re.fullmatch(r"proc-\d+-of-\d+", name):
-                CheckpointManager(os.path.join(root, name), keep=2).clear()
+            sub = os.path.join(root, name)
+            if re.fullmatch(r"proc-\d+-of-\d+", name) and \
+                    self.run_id and self._read_tag(sub) == self.run_id:
+                self._remove_tag(sub)
+                CheckpointManager(sub, keep=2).clear()
         try:
             os.rmdir(root)                   # only succeeds when empty
+        except OSError:
+            pass
+
+    @staticmethod
+    def _remove_tag(directory: str) -> None:
+        try:
+            os.remove(os.path.join(directory, "RUN_TAG"))
         except OSError:
             pass

@@ -257,7 +257,21 @@ class NumericalAttrStats(Job):
         moments stable), snapshots ride unique keys through the union merge
         (never summed), and finalization translates every snapshot to the
         group's lowest-chunk anchor shift and folds in ascending chunk
-        index — the f64 addition sequence does not depend on nprocs."""
+        index — the f64 addition sequence does not depend on nprocs.
+
+        State-growth contract (round-5 advisor finding): the per-(chunk,
+        group) snapshots are merge keys, so host state — and, under
+        ``jax.distributed``, the single end-of-stream allgather payload —
+        grows as O(chunks × groups) × 6·A·8 bytes.  Small
+        ``stream.chunk.rows`` against a huge input, or a high-cardinality
+        ``cond.attr.ord``, can push that into gigabytes (and toward the
+        2^31-byte packed-gather limit of ``all_process_sum_state``);
+        ``stream.stats.max.state.mb`` (default 1024) bounds it LOUDLY —
+        raise the chunk size (fewer snapshots), drop the conditioning
+        column's cardinality, or lift the cap explicitly.  Chunk keys are
+        zero-padded to 12 digits so the ascending-key finalize fold stays
+        ordered; the index is asserted below the format width (the old
+        8-digit format silently mis-ordered past 10^8 chunks)."""
         import numpy as np
 
         from avenir_tpu.core.config import ConfigError
@@ -286,10 +300,18 @@ class NumericalAttrStats(Job):
         owner, _acc, distributed = self.distributed_plan(conf, None)
         mesh = self.auto_mesh(conf)
         a = len(attr_ords)
+        max_state_bytes = conf.get_int("stream.stats.max.state.mb", 1024) << 20
+        state_bytes = 0
+        overflow = None            # guard tripped: raise AFTER the collective
         state: dict = {}
         nrows = 0
         for idx, lines in self.iter_line_chunks_retrying(
                 conf, input_path, counters, owner=owner, emit_index=True):
+            if idx >= 10 ** 12:
+                raise ConfigError(
+                    f"chunk index {idx} exceeds the 12-digit snapshot-key "
+                    f"width; raise stream.chunk.rows (keys past the width "
+                    f"would silently mis-order the finalize fold)")
             rows = np.array([ln.split(delim) for ln in lines], dtype=object)
             nrows += len(rows)
             vals64 = rows[:, attr_ords].astype(np.float64)
@@ -321,20 +343,48 @@ class NumericalAttrStats(Job):
                 if not cnt[ci]:
                     continue
                 sel = vals64[labels == ci]
-                state[f"c{idx:08d}:{g}"] = np.stack([
+                snap = np.stack([
                     np.full(a, cnt[ci]), s1[ci], s2[ci], shift[ci],
                     sel.min(axis=0), sel.max(axis=0)])
+                state[f"c{idx:012d}:{g}"] = snap
+                state_bytes += snap.nbytes
+                if state_bytes > max_state_bytes:
+                    overflow = (
+                        f"NumericalAttrStats snapshot state exceeds "
+                        f"stream.stats.max.state.mb="
+                        f"{max_state_bytes >> 20} after {len(state)} "
+                        f"(chunk, group) snapshots — state grows as "
+                        f"O(chunks × groups); raise stream.chunk.rows, "
+                        f"reduce cond.attr.ord cardinality, or lift the cap")
+                    break
+            if overflow:
+                break
         merged_rows = nrows
         if distributed:
+            # the guard must not strand peers: every process enters the
+            # end-of-stream collective exactly once, an overflow flag rides
+            # the same packed gather, and ALL processes raise together
+            # (same error-through-the-collective pattern as the LR resume
+            # broadcast in jobs/regress.py)
             from avenir_tpu.parallel.mesh import all_process_sum_state
             state["__rows__"] = np.array([nrows], np.int64)
+            state["__overflow__"] = np.array([1 if overflow else 0], np.int64)
             state = all_process_sum_state(state)
             merged_rows = int(state.pop("__rows__")[0])
+            if int(state.pop("__overflow__")[0]):
+                raise ConfigError(overflow or (
+                    "a peer process exceeded stream.stats.max.state.mb "
+                    "(O(chunks × groups) snapshot growth); raise "
+                    "stream.chunk.rows, reduce cond.attr.ord cardinality, "
+                    "or lift the cap"))
+        if overflow:
+            raise ConfigError(overflow)
 
-        # finalize: group → snapshots in ascending chunk order
+        # finalize: group → snapshots in ascending chunk order (keys are
+        # zero-padded to a fixed 12-digit width, so lexicographic == numeric)
         by_group: dict = {}
         for k in sorted(state):                    # ascending chunk index
-            by_group.setdefault(k[10:], []).append(state[k])
+            by_group.setdefault(k.split(":", 1)[1], []).append(state[k])
         d = conf.field_delim
         out: List[str] = []
         totals = {}

@@ -19,6 +19,45 @@ from avenir_tpu.models import samplers
 from avenir_tpu.utils.metrics import Counters
 
 
+def mi_output_lines(conf: JobConfig, result, names: List[str]) -> List[str]:
+    """The MutualInformation job's output lines from a finished result —
+    the ONE assembly used by both the standalone job and the SharedScan
+    fused path (``pipeline/scan.py``), so the two can never drift."""
+    delim = conf.field_delim
+    lines: List[str] = []
+    if conf.get_bool("output.mutual.info", True):
+        lines.extend(result.to_lines(delim=delim))
+    for algo in conf.get_list("mutual.info.score.algorithms", ["mim"]):
+        kwargs = {}
+        if algo == "mifs":
+            kwargs["redundancy_factor"] = conf.get_float(
+                "mutual.info.redundancy.factor", 1.0)
+        ranked = mi.score_features(result, algo, **kwargs)
+        lines.append(f"featureScore:{algo}")
+        lines.extend(
+            delim.join([names[f], f"{score:.6f}"]) for f, score in ranked)
+    return lines
+
+
+def correlation_plan(conf: JobConfig, schema, enc):
+    """(src_idx, dst_idx, against_class, names) for a correlation job's
+    attribute selection — shared by the standalone jobs and the SharedScan
+    fused path.  Source/dest attribute lists arrive as schema ordinals
+    (CramerCorrelation.java:95-100) and are mapped to binned indices; a
+    dest list of exactly the class ordinal selects against-class mode."""
+    binned_ords = [f.ordinal for f in enc.binned_fields]
+    names = [schema.field_by_ordinal(o).name for o in binned_ords]
+    ord_to_idx = {o: i for i, o in enumerate(binned_ords)}
+    src = conf.get_int_list("source.attributes")
+    dst = conf.get_int_list("dest.attributes")
+    class_ord = schema.class_field.ordinal if schema.class_field else None
+    against_class = dst is not None and class_ord is not None and dst == [class_ord]
+    src_idx = [ord_to_idx[o] for o in src] if src else None
+    dst_idx = (None if against_class or dst is None
+               else [ord_to_idx[o] for o in dst])
+    return src_idx, dst_idx, against_class, names
+
+
 class MutualInformation(Job):
     """One-pass distributions + MI + feature-selection scores.
 
@@ -33,7 +72,6 @@ class MutualInformation(Job):
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
                 counters: Counters) -> None:
-        delim = conf.field_delim
         schema = self.load_schema(conf)
         mesh = self.auto_mesh(conf)
         ckpt = self.stream_checkpointer(conf)
@@ -58,18 +96,7 @@ class MutualInformation(Job):
         else:
             result = mi.MutualInformation(mesh=mesh).fit(
                 data, feature_names=names, accumulator=acc)
-        lines: List[str] = []
-        if conf.get_bool("output.mutual.info", True):
-            lines.extend(result.to_lines(delim=delim))
-        for algo in conf.get_list("mutual.info.score.algorithms", ["mim"]):
-            kwargs = {}
-            if algo == "mifs":
-                kwargs["redundancy_factor"] = conf.get_float(
-                    "mutual.info.redundancy.factor", 1.0)
-            ranked = mi.score_features(result, algo, **kwargs)
-            lines.append(f"featureScore:{algo}")
-            lines.extend(
-                delim.join([names[f], f"{score:.6f}"]) for f, score in ranked)
+        lines = mi_output_lines(conf, result, names)
         rows = merged["rows"] if distributed else rows_fn()
         if self.is_output_writer():
             write_output(output_path, lines)
@@ -99,22 +126,13 @@ class _CorrelationJob(Job):
                                                       mesh=mesh,
                                                       checkpointer=ckpt,
                                                       owner=owner)
-        binned_ords = [f.ordinal for f in enc.binned_fields]
-        names = [schema.field_by_ordinal(o).name for o in binned_ords]
-        # source/dest attribute lists arrive as schema ordinals
-        # (CramerCorrelation.java:95-100); map them to binned indices
-        ord_to_idx = {o: i for i, o in enumerate(binned_ords)}
-        src = conf.get_int_list("source.attributes")
-        dst = conf.get_int_list("dest.attributes")
-        class_ord = schema.class_field.ordinal if schema.class_field else None
-        against_class = dst is not None and class_ord is not None and dst == [class_ord]
+        src_idx, dst_idx, against_class, names = correlation_plan(conf, schema, enc)
         job = corr.CategoricalCorrelation(algorithm=self._algorithm(conf),
                                           mesh=mesh)
         fit = lambda d: job.fit(
             d,
-            src=[ord_to_idx[o] for o in src] if src else None,
-            dst=(None if against_class or dst is None
-                 else [ord_to_idx[o] for o in dst]),
+            src=src_idx,
+            dst=dst_idx,
             against_class=against_class,
             feature_names=names,
             accumulator=acc,

@@ -55,6 +55,39 @@ def _einsum_key_prefix(f: int, b_dst: int, pairs) -> str:
     return f"c{f}x{b_dst}p{len(pairs)}h{digest}"
 
 
+def result_from_counts(
+    algorithm: str,
+    pairs: List[Tuple[int, int]],
+    pair_names: List[Tuple[str, str]],
+    contingency: np.ndarray,
+    n_bins: np.ndarray,
+    num_classes: int,
+) -> "CorrelationResult":
+    """:class:`CorrelationResult` from an already-aggregated [P, Bd, Bd]
+    contingency stack, without touching data — the finalize step of
+    :meth:`CategoricalCorrelation.fit` and the SharedScan seam
+    (``pipeline/scan.py``): every pair's contingency table is a read-out
+    of the shared co-occurrence gram (class-summed for feature pairs, the
+    [F, B, C] diagonal block for against-class pairs).  ``pairs`` use the
+    fit contract (dst index −1 = the class attribute)."""
+    if algorithm not in STATS:
+        raise ValueError(f"unknown algorithm {algorithm!r}; known: {sorted(STATS)}")
+    # statistic over the true (rows, cols) support of each pair; tiny
+    # tensors — keep the per-pair ops on the local CPU backend
+    stat = np.zeros(len(pairs))
+    stat_fn = STATS[algorithm]
+    with info.on_host():
+        for k, (i, j) in enumerate(pairs):
+            rows = int(n_bins[i])
+            cols = int(num_classes) if j < 0 else int(n_bins[j])
+            stat[k] = float(stat_fn(
+                jnp.asarray(contingency[k, :rows, :cols], jnp.float32)))
+    return CorrelationResult(
+        pairs=pairs, pair_names=pair_names, stat=stat,
+        algorithm=algorithm, contingency=contingency,
+    )
+
+
 @dataclass
 class CorrelationResult:
     pairs: List[Tuple[int, int]]         # (src binned-index, dst binned-index)
@@ -186,19 +219,8 @@ class CategoricalCorrelation:
                 for s in range(0, len(pairs), self.pair_chunk)])
         else:
             cont = np.zeros((0, b_dst, b_dst), np.int64)
-        # statistic over the true (rows, cols) support of each pair; tiny
-        # tensors — keep the per-pair ops on the local CPU backend
-        stat = np.zeros(len(pairs))
-        stat_fn = STATS[self.algorithm]
-        with info.on_host():
-            for k, (i, j) in enumerate(pairs):
-                rows = int(meta.n_bins[i])
-                cols = int(meta.num_classes) if j < 0 else int(meta.n_bins[j])
-                stat[k] = float(stat_fn(jnp.asarray(cont[k, :rows, :cols], jnp.float32)))
-        return CorrelationResult(
-            pairs=pairs, pair_names=pair_names, stat=stat,
-            algorithm=self.algorithm, contingency=cont,
-        )
+        return result_from_counts(self.algorithm, pairs, pair_names, cont,
+                                  meta.n_bins, meta.num_classes)
 
 
 class CramerCorrelation(CategoricalCorrelation):

@@ -48,6 +48,36 @@ class FisherDiscriminantModel:
         ]
 
 
+def model_from_moments(class_values: List[str], cnt: np.ndarray,
+                       s1: np.ndarray, s2: np.ndarray) -> FisherDiscriminantModel:
+    """:class:`FisherDiscriminantModel` from already-aggregated per-class
+    (count [2], Σx [2, F], Σx² [2, F]) moment sums, without touching data —
+    the finalize step of :meth:`FisherDiscriminant.fit` and the SharedScan
+    seam (``pipeline/scan.py``): the moments come from the same
+    ``class_moments`` contraction the shared scan runs on its resident
+    chunk, fused into the gram dispatch."""
+    if len(class_values) != 2:
+        raise ValueError("Fisher discriminant requires exactly two classes")
+    if s1.shape[1] == 0:
+        raise ValueError("Fisher discriminant requires continuous features")
+    cnt = np.asarray(cnt, np.float64)                 # [2]
+    s1 = np.asarray(s1, np.float64)                   # [2, F]
+    s2 = np.asarray(s2, np.float64)
+    n = np.maximum(cnt, 1.0)[:, None]
+    mean = s1 / n
+    var_b = np.maximum(s2 / n - mean ** 2, 1e-12)
+    var = var_b * (n / np.maximum(n - 1.0, 1.0))      # unbiased, as (n−1) division
+    pooled = (((n - 1.0) * var).sum(axis=0) / np.maximum(cnt.sum() - 2.0, 1.0))
+    log_odds = float(np.log(max(cnt[1], 1e-9) / max(cnt[0], 1e-9)))
+    delta = mean[0] - mean[1]
+    safe_delta = np.where(np.abs(delta) > 1e-9, delta, 1e-9)
+    boundary = (mean[0] + mean[1]) / 2.0 - log_odds * pooled / safe_delta
+    return FisherDiscriminantModel(
+        class_values=list(class_values), mean=mean, var=var, count=cnt,
+        pooled_var=pooled, log_odds=log_odds, boundary=boundary,
+    )
+
+
 class FisherDiscriminant:
     def __init__(self, mesh=None):
         self.mesh = mesh          # optional data mesh (parallel/mesh.py)
@@ -74,21 +104,8 @@ class FisherDiscriminant:
             raise ValueError("Fisher discriminant requires exactly two classes")
         if meta.num_cont == 0:
             raise ValueError("Fisher discriminant requires continuous features")
-        cnt = acc.get("cnt")                              # [2]
-        s1, s2 = acc.get("s1"), acc.get("s2")             # [2, F]
-        n = np.maximum(cnt, 1.0)[:, None]
-        mean = s1 / n
-        var_b = np.maximum(s2 / n - mean ** 2, 1e-12)
-        var = var_b * (n / np.maximum(n - 1.0, 1.0))      # unbiased, as (n−1) division
-        pooled = (((n - 1.0) * var).sum(axis=0) / np.maximum(cnt.sum() - 2.0, 1.0))
-        log_odds = float(np.log(max(cnt[1], 1e-9) / max(cnt[0], 1e-9)))
-        delta = mean[0] - mean[1]
-        safe_delta = np.where(np.abs(delta) > 1e-9, delta, 1e-9)
-        boundary = (mean[0] + mean[1]) / 2.0 - log_odds * pooled / safe_delta
-        return FisherDiscriminantModel(
-            class_values=list(meta.class_values), mean=mean, var=var, count=cnt,
-            pooled_var=pooled, log_odds=log_odds, boundary=boundary,
-        )
+        return model_from_moments(list(meta.class_values), acc.get("cnt"),
+                                  acc.get("s1"), acc.get("s2"))
 
     @staticmethod
     def predict(model: FisherDiscriminantModel, values: np.ndarray, attr: int = 0) -> np.ndarray:

@@ -532,7 +532,10 @@ def viterbi_time_sharded(log_a: jax.Array, log_b: jax.Array, log_pi: jax.Array,
     """
     import functools as _ft
 
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:                    # pre-move jax (parallel/collectives)
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     s = log_a.shape[0]
@@ -561,7 +564,11 @@ def viterbi_time_sharded(log_a: jax.Array, log_b: jax.Array, log_pi: jax.Array,
         def offset_scan(carry, x):
             return _maxplus(carry, x), carry
 
-        init = jax.lax.pcast(eye, (axis,), to="varying")
+        # newer jax's varying-type system needs the closed-over constant
+        # cast to device-varying before the scan; pre-varying jax treats
+        # every array as device-local already, so the cast is an identity
+        pcast = getattr(jax.lax, "pcast", None)
+        init = pcast(eye, (axis,), to="varying") if pcast else eye
         _, excl = jax.lax.scan(offset_scan, init, totals)      # [D, S, S]
         global_prefix = _maxplus(excl[idx][None], prefix)      # [L, S, S]
         # δ_t = δ_0 ⊗ (M_1 … M_t); δ_0 from the replicated first observation

@@ -120,6 +120,31 @@ class MutualInfoResult:
 
 
 
+def result_from_counts(
+    feature_names: Sequence[str],
+    class_values: Sequence[str],
+    n_bins: np.ndarray,
+    class_counts: np.ndarray,
+    feature_class_counts: np.ndarray,
+    pair_index: np.ndarray,
+    pair_class_counts: np.ndarray,
+) -> MutualInfoResult:
+    """Finished :class:`MutualInfoResult` from already-aggregated count
+    tensors, without touching data — the finalize step of
+    :meth:`MutualInformation.fit` and the SharedScan seam
+    (``pipeline/scan.py``): both the [F, B, C] and [P, B, B, C] tensors
+    are read-outs of the shared co-occurrence gram."""
+    return MutualInfoResult(
+        feature_names=list(feature_names),
+        class_values=list(class_values),
+        n_bins=np.asarray(n_bins, np.int64),
+        class_counts=np.asarray(class_counts),
+        feature_class_counts=np.asarray(feature_class_counts),
+        pair_index=np.asarray(pair_index),
+        pair_class_counts=np.asarray(pair_class_counts),
+    ).finish()
+
+
 @jax.jit
 def _derived_stats(fcc, pcc, cc):
     """All of finish()'s derived statistics as ONE compiled program.
@@ -237,15 +262,15 @@ class MutualInformation:
             pcc_full = np.zeros((0, b, b, c), np.int64)
         names = list(feature_names) if feature_names is not None else [
             f"f{o}" for o in meta.binned_ordinals]
-        return MutualInfoResult(
+        return result_from_counts(
             feature_names=names,
             class_values=list(meta.class_values),
-            n_bins=np.asarray(meta.n_bins, np.int64),
+            n_bins=meta.n_bins,
             class_counts=acc.get("class"),
             feature_class_counts=fc_full,
             pair_index=pair_index,
             pair_class_counts=pcc_full,
-        ).finish()
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -104,6 +104,40 @@ class NaiveBayesModel:
         )
 
 
+def model_from_counts(
+    class_values: Sequence[str],
+    n_bins: np.ndarray,
+    bin_counts: Optional[np.ndarray],
+    class_counts: np.ndarray,
+    cont_count: Optional[np.ndarray] = None,
+    cont_sum: Optional[np.ndarray] = None,
+    cont_sumsq: Optional[np.ndarray] = None,
+    laplace: float = 1.0,
+) -> NaiveBayesModel:
+    """Build a :class:`NaiveBayesModel` from already-aggregated count
+    tables, without touching data — the finalize step of :meth:`NaiveBayes.fit`
+    and the SharedScan seam (``pipeline/scan.py``): the [F, B, C] table is
+    the diagonal block of the shared co-occurrence gram, so a scan that
+    already computed G builds this model for free.  ``bin_counts=None``
+    means no binned features (an all-zero table is substituted)."""
+    n_bins = np.asarray(n_bins, np.int64)
+    f = len(n_bins)
+    bmax = int(n_bins.max()) if f else 0
+    c = len(class_values)
+    if bin_counts is None:
+        bin_counts = np.zeros((f, bmax, c))
+    return NaiveBayesModel(
+        class_values=list(class_values),
+        n_bins=n_bins,
+        bin_counts=np.asarray(bin_counts).astype(np.float64),
+        class_counts=np.asarray(class_counts).astype(np.float64),
+        cont_count=cont_count,
+        cont_sum=cont_sum,
+        cont_sumsq=cont_sumsq,
+        laplace=laplace,
+    )
+
+
 @jax.jit
 def nb_log_scores(
     log_posterior: jax.Array,   # [F, B, C]
@@ -190,13 +224,11 @@ class NaiveBayes:
                 acc.add("cont_count", cnt)
                 acc.add("cont_sum", s1)
                 acc.add("cont_sumsq", s2)
-        f, bmax, cnum = meta.num_binned, meta.max_bins, meta.num_classes
-        return NaiveBayesModel(
+        return model_from_counts(
             class_values=list(meta.class_values),
             n_bins=np.asarray(meta.n_bins, np.int64),
-            bin_counts=(acc.get("bin_counts").astype(np.float64)
-                        if "bin_counts" in acc else np.zeros((f, bmax, cnum))),
-            class_counts=acc.get("class_counts").astype(np.float64),
+            bin_counts=(acc.get("bin_counts") if "bin_counts" in acc else None),
+            class_counts=acc.get("class_counts"),
             cont_count=(acc.get("cont_count") if "cont_count" in acc else None),
             cont_sum=(acc.get("cont_sum") if "cont_sum" in acc else None),
             cont_sumsq=(acc.get("cont_sumsq") if "cont_sumsq" in acc else None),

@@ -86,6 +86,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams → CompilerParams; a module-local alias
+# (no mutation of the shared pltpu module) keeps the kernels running on
+# either side of the rename — pallas_knn and the standalone probes carry
+# the same two-liner
+COMPILER_PARAMS = (pltpu.CompilerParams if hasattr(pltpu, "CompilerParams")
+                   else pltpu.TPUCompilerParams)
+
 # joint-code marker for invalid rows / padding: never equals a selector
 # value (selectors are in [0, B·C) plus the pad marker below)
 _INVALID = -(1 << 20)
@@ -127,13 +134,26 @@ def _ru(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+# fmaj-vs-jmaj width slack: the fmaj broadcast expand keeps only int8 in
+# VMEM, while jmaj materializes an int32 [Wp, BN] block — measured round 4
+# at +19% for fmaj at EQUAL width, and the one-class Cramér gram (jmaj,
+# wp=256) ran at ~33 effective TOPS against the 115-125 TOPS the fmaj
+# W=384 gram sustains, i.e. jmaj's expand overhead dwarfs a ≤1.5× wider
+# dot at these widths.  So fmaj is preferred unless its padding widens
+# the gram by MORE than this factor (round 7; the Cramér family shape
+# 10×20×1 — wp 384 vs 256 — now rides fmaj).
+_FMAJ_WIDEN = 1.5
+
+
 def plan(num_feat: int, num_bins: int, num_classes: int):
     """Static layout plan → (mode, jcp, wp).
 
     ``fmaj``: w = f·jcp + (bin·C + cls), jcp = jc rounded up to 32 (clean
     int8 tiling for the broadcast expand).  Chosen unless that padding
-    would widen the padded gram (wp) versus the j-major packing — the dot
-    is ~90% of kernel time, so layout must never inflate it.
+    would widen the padded gram (wp) by more than ``_FMAJ_WIDEN`` versus
+    the j-major packing — the dot is the dominant cost at large widths,
+    but at kernel-eligible widths the int8-only expand buys back a
+    modestly wider gram (see _FMAJ_WIDEN).
 
     ``cls`` (wide shapes): G is [C, wp, wp] with per-class row index
     w = bin·F + f (j-major within the class) — the per-class gram split
@@ -143,7 +163,10 @@ def plan(num_feat: int, num_bins: int, num_classes: int):
     jcp32 = _ru(jc, 32)
     wp32 = _ru(num_feat * jcp32, 128)
     wpj = _ru(num_feat * jc, 128)
-    narrow = ("fmaj", jcp32, wp32) if wp32 <= wpj else ("jmaj", jc, wpj)
+    if wp32 <= wpj or (wp32 <= MAX_W and wp32 <= _FMAJ_WIDEN * wpj):
+        narrow = ("fmaj", jcp32, wp32)
+    else:
+        narrow = ("jmaj", jc, wpj)
     if narrow[2] <= MAX_W:
         return narrow
     wcp = _ru(num_feat * num_bins, 128)
@@ -416,7 +439,7 @@ def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
                                    lambda r, i: (0, r, 0),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct(out_shape, jnp.int32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=COMPILER_PARAMS(
                 dimension_semantics=("arbitrary", "arbitrary"),
                 vmem_limit_bytes=110 * 1024 * 1024),
             interpret=interpret,
@@ -440,7 +463,7 @@ def cooc_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
                                memory_space=pltpu.VMEM)],
         out_specs=out_specs,
         out_shape=jax.ShapeDtypeStruct(out_shape, jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
@@ -537,7 +560,7 @@ def cross_cooc_counts_cols(codes_t: jax.Array, sel: jax.Array,
         out_specs=pl.BlockSpec((wp, sp_dim), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((wp, sp_dim), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
@@ -558,6 +581,32 @@ def cooc_counts(codes: jax.Array, labels: jax.Array, num_bins: int,
     return cooc_counts_cols.__wrapped__(
         codes.T, labels, num_bins, num_classes, block_cols=block_cols,
         interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_bins", "num_classes", "block_cols", "interpret"))
+def gram_moments(codes: jax.Array, labels: jax.Array, cont: jax.Array,
+                 num_bins: int, num_classes: int, *,
+                 block_cols: int | None = None,
+                 interpret: bool = False):
+    """Single-dispatch SharedScan step (round 7): the co-occurrence gram G
+    of the chunk's binned codes PLUS the class-conditional (count, Σx, Σx²)
+    moments of the SAME device-resident continuous block, as ONE compiled
+    program — so a scan serving NB + MI + Cramér + Fisher/NumericalAttrStats
+    consumers (``pipeline/scan.py``) pays one dispatch per chunk, exactly
+    like the single-job fast path.
+
+    codes [N, F] int, labels [N] int, cont [N, Fc] float →
+    (G, cnt [C], s1 [C, Fc], s2 [C, Fc]).  G and the count tensors derived
+    from it are bit-identical to :func:`cooc_counts`; the moment sums are
+    the same ``agg.class_moments`` contraction the standalone fits run."""
+    from avenir_tpu.ops import agg
+
+    g = cooc_counts_cols.__wrapped__(codes.T, labels, num_bins, num_classes,
+                                     block_cols=block_cols,
+                                     interpret=interpret)
+    cnt, s1, s2 = agg.class_moments.__wrapped__(cont, labels, num_classes)
+    return g, cnt, s1, s2
 
 
 def counts_from_cooc(g, num_feat: int, num_bins: int, num_classes: int,

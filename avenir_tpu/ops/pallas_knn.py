@@ -46,6 +46,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams → CompilerParams; module-local alias,
+# same as ops/pallas_hist.py (no mutation of the shared pltpu module)
+COMPILER_PARAMS = (pltpu.CompilerParams if hasattr(pltpu, "CompilerParams")
+                   else pltpu.TPUCompilerParams)
+
 # Block shapes. TM query rows are resident per grid row; TN reference rows
 # stream through VMEM per grid step. Kept candidates live in SLOTS lanes so
 # the best-buffer is VPU-tile aligned; unused slots are pinned to -_BIG so
@@ -364,7 +369,7 @@ def _topk_tourney_traced(a_mat, b_mat, k: int):
         ],
         out_specs=[spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((m, nbp), jnp.int32)] * 3,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024),
     )(a_mat, b_mat)
@@ -519,7 +524,7 @@ def _topk_pallas_traced(a_mat, b_mat, k: int):
             pltpu.VMEM((TM, SLOTS), jnp.float32),
             pltpu.VMEM((TM, SLOTS), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(a_mat, b_mat)
     neg, pos = jax.lax.top_k(-best_d2[:, :k], k)

@@ -81,6 +81,48 @@ class Pipeline:
                  if isinstance(v, str) and v.startswith("@")]
         return deps
 
+    def _stage_conf(self, stage: Stage) -> JobConfig:
+        conf = JobConfig(dict(self.conf.props), prefix=self.conf.prefix)
+        for k, v in stage.props.items():
+            # per-stage overrides may reference artifacts as @name
+            if isinstance(v, str) and v.startswith("@"):
+                v = self.path(v[1:])
+            conf.set(k, v)
+        return conf
+
+    def _scan_group(self, todo: List[Stage], i: int, resume: bool):
+        """Maximal run of consecutive stages starting at ``todo[i]`` that
+        one SharedScan can serve: every stage a fusable count job over the
+        SAME input artifact, none consuming another group member's output,
+        none already satisfied under ``resume``, and all stage confs
+        compatible (same schema/delimiter/stream keys — see
+        ``pipeline/scan.py``).  Returns ``(stages, confs)`` — a singleton
+        when nothing fuses; the confs are reused by the caller so a stage
+        conf is only ever built once."""
+        from avenir_tpu.pipeline import scan
+
+        first = todo[i]
+        in_path = self.path(first.input)
+        group: List[Stage] = []
+        confs: List[JobConfig] = []
+        outputs: set = set()
+        for s in todo[i:]:
+            if self.path(s.input) != in_path:
+                break
+            if resume and os.path.exists(self.path(s.output)):
+                break
+            if any(a in outputs for a in self._deps(s)):
+                break          # consumes an output of an earlier group member
+            conf = self._stage_conf(s)
+            if not scan.stage_fusable(s.job, conf):
+                break
+            group.append(s)
+            confs.append(conf)
+            outputs.add(s.output)
+        if len(group) > 1 and scan.stages_compatible(confs):
+            return group, confs
+        return [first], confs[:1]
+
     def run(self, only: Optional[Sequence[str]] = None,
             resume: bool = False) -> Dict[str, Counters]:
         if only is None:
@@ -99,17 +141,30 @@ class Pipeline:
                         needed[prod.name] = True
                         frontier.append(prod)
             todo = [s for s in self.stages if s.name in needed]
-        for stage in todo:
+        i = 0
+        while i < len(todo):
+            stage = todo[i]
             out = self.path(stage.output)
             if resume and os.path.exists(out):
+                i += 1
                 continue
-            conf = JobConfig(dict(self.conf.props), prefix=self.conf.prefix)
-            for k, v in stage.props.items():
-                # per-stage overrides may reference artifacts as @name
-                if isinstance(v, str) and v.startswith("@"):
-                    v = self.path(v[1:])
-                conf.set(k, v)
-            self.counters[stage.name] = stage.run(conf, self.path(stage.input), out)
+            # stage fusion (round 7): consecutive count jobs reading the
+            # same artifact with a compatible schema collapse into ONE
+            # SharedScan — one parse+encode+gram pass serving every stage
+            # (scan.fuse=false opts a stage or the whole pipeline out)
+            group, gconfs = self._scan_group(todo, i, resume)
+            if len(group) > 1:
+                from avenir_tpu.pipeline import scan
+
+                self.counters.update(scan.run_fused_stages(
+                    [(s.name, s.job, self.path(s.input), self.path(s.output),
+                      conf) for s, conf in zip(group, gconfs)]))
+                i += len(group)
+                continue
+            conf = gconfs[0] if gconfs else self._stage_conf(stage)
+            self.counters[stage.name] = stage.run(
+                conf, self.path(stage.input), out)
+            i += 1
         return self.counters
 
 

@@ -1,0 +1,497 @@
+"""SharedScan engine — one encode+gram pass serving every contingency-table job.
+
+The reference runs one MapReduce Tool per statistic: BayesianDistribution,
+MutualInformation and CategoricalCorrelation are separate jobs that each
+rescan the same HDFS dataset.  The port inherited that shape — each
+estimator's ``fit`` re-parsed, re-encoded, re-uploaded and re-aggregated the
+same chunks, so a churn/readmission pipeline paid K scans for one scan's
+worth of information.  This module collapses the K scans into one:
+
+- ONE chunk stream (native parse → encode → ``DeviceFeeder`` staging, once,
+  via the jobs' existing ``encoded_data_source``);
+- ONE device pass per chunk: the fused int8-MXU co-occurrence gram G
+  (``ops/pallas_hist``), with the class-conditional continuous moments of
+  the same resident chunk folded into the SAME dispatch
+  (``pallas_hist.gram_moments``) when any consumer wants them;
+- 64-bit host accumulation keyed by the existing layout-qualified
+  ``g_key`` scheme, exactly like the standalone fast paths;
+- at end of stream, each registered consumer is finalized from the shared
+  tables through the models' ``from_counts`` constructors — NB's [F, B, C]
+  table is G's diagonal block, MI's pair tensors are
+  ``counts_from_cooc``, Cramér/heterogeneity contingency tables are the
+  class-summed pair read-out (or the [F, B, C] block against the class),
+  and Fisher/NumericalAttrStats statistics reduce from the fused moments.
+
+Consumers are byte-identical to running each estimator's own ``fit`` over
+the same chunks (tests/test_scan.py), on both the kernel and the einsum
+fallback paths.
+
+Row-validity contract: rows whose label is out of range drop out of EVERY
+table (the NB/MI drop-invalid contract).  A *standalone* pair-mode Cramér
+run counts such rows (its one-class gram ignores labels), so fused
+semantics match the standalone jobs only for fully-labeled streams — which
+is what the fusable jobs already require.
+
+``pipeline/driver.py`` fuses consecutive pipeline stages that read the same
+artifact with a compatible schema into one SharedScan stage
+(``scan.fuse=false`` opts a stage — or a whole pipeline — out).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from avenir_tpu.core.encoding import EncodedDataset, peek_chunks
+from avenir_tpu.ops import agg
+from avenir_tpu.utils.metrics import Counters
+
+
+class ScanError(ValueError):
+    """A SharedScan configuration the engine cannot serve."""
+
+
+class ScanTables:
+    """The shared per-stream totals every consumer finalizes from."""
+
+    def __init__(self, meta: EncodedDataset, rows: int,
+                 class_counts: np.ndarray,
+                 fbc: Optional[np.ndarray],
+                 pair_index: np.ndarray,
+                 pcc: Optional[np.ndarray],
+                 moments: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]):
+        self.meta = meta                      # first-chunk shape metadata
+        self.rows = rows
+        self.class_counts = class_counts      # [C] int64
+        self.fbc = fbc                        # [F, B, C] int64 or None
+        self.pair_index = pair_index          # [P, 2] all i<j binned pairs
+        self.pcc = pcc                        # [P, B, B, C] int64 or None
+        self.moments = moments                # (cnt [C], s1 [C,Fc], s2) or None
+
+    def pair_pos(self) -> Dict[Tuple[int, int], int]:
+        return {(int(i), int(j)): k
+                for k, (i, j) in enumerate(self.pair_index)}
+
+
+class ScanConsumer:
+    """Base consumer: declare what the scan must compute, finalize from
+    the shared tables.  ``name`` keys the result in :meth:`SharedScan.run`'s
+    output dict (pipeline stages use their stage name)."""
+
+    needs_bin = False          # the [F, B, C] class-conditional table
+    needs_pairs = False        # the [P, B, B, C] pair-class tensors
+    needs_moments = False      # continuous (count, Σx, Σx²) class moments
+
+    def __init__(self, name: str = ""):
+        self.name = name or type(self).__name__
+
+    def required_pairs(self, num_binned: int) -> List[Tuple[int, int]]:
+        """The (i, j) i<j feature pairs this consumer reads.  The engine
+        aggregates only the UNION across consumers — a correlation stage
+        restricted to a few attribute pairs must not drag the all-pairs
+        [P, B, B, C] tensor through the einsum fallback."""
+        return []
+
+    def finalize(self, tables: ScanTables):
+        raise NotImplementedError
+
+
+class NaiveBayesConsumer(ScanConsumer):
+    """NB class-conditional counts are G's [F, B, C] diagonal block; the
+    Gaussian moments ride the fused moment op.  Finalizes through
+    ``naive_bayes.model_from_counts`` — byte-identical to ``NaiveBayes.fit``."""
+
+    needs_bin = True
+    needs_moments = True
+
+    def __init__(self, laplace: float = 1.0, name: str = ""):
+        super().__init__(name)
+        self.laplace = laplace
+
+    def finalize(self, t: ScanTables):
+        from avenir_tpu.models import naive_bayes as nb
+
+        mom = t.moments
+        return nb.model_from_counts(
+            class_values=list(t.meta.class_values),
+            n_bins=np.asarray(t.meta.n_bins, np.int64),
+            bin_counts=t.fbc,
+            class_counts=t.class_counts,
+            cont_count=mom[0] if mom is not None else None,
+            cont_sum=mom[1] if mom is not None else None,
+            cont_sumsq=mom[2] if mom is not None else None,
+            laplace=self.laplace,
+        )
+
+
+class MutualInfoConsumer(ScanConsumer):
+    """All seven MI distribution families from the shared [F, B, C] and
+    [P, B, B, C] tensors — ``mutual_info.result_from_counts``."""
+
+    needs_bin = True
+    needs_pairs = True
+
+    def __init__(self, feature_names: Optional[Sequence[str]] = None,
+                 name: str = ""):
+        super().__init__(name)
+        self.feature_names = feature_names
+
+    def required_pairs(self, num_binned: int) -> List[Tuple[int, int]]:
+        return [(i, j) for i in range(num_binned)
+                for j in range(i + 1, num_binned)]
+
+    def finalize(self, t: ScanTables):
+        from avenir_tpu.models import mutual_info as mi
+
+        meta = t.meta
+        f, b, c = meta.num_binned, meta.max_bins, meta.num_classes
+        names = (list(self.feature_names) if self.feature_names is not None
+                 else [f"f{o}" for o in meta.binned_ordinals])
+        fbc = t.fbc if t.fbc is not None else np.zeros((f, b, c), np.int64)
+        pcc = t.pcc if t.pcc is not None else np.zeros((0, b, b, c), np.int64)
+        return mi.result_from_counts(
+            feature_names=names,
+            class_values=list(meta.class_values),
+            n_bins=meta.n_bins,
+            class_counts=t.class_counts,
+            feature_class_counts=fbc,
+            pair_index=t.pair_index,
+            pair_class_counts=pcc,
+        )
+
+
+class CorrelationConsumer(ScanConsumer):
+    """Cramér / heterogeneity statistics from the shared gram: the
+    against-class contingency stack is the [F, B, C] diagonal block, the
+    feature-pair stack is the class-summed pair read-out —
+    ``correlation.result_from_counts``.  Mirrors the attribute-selection
+    contract of ``CategoricalCorrelation.fit``."""
+
+    def __init__(self, algorithm: str = "cramerIndex",
+                 src: Optional[Sequence[int]] = None,
+                 dst: Optional[Sequence[int]] = None,
+                 against_class: bool = False,
+                 feature_names: Optional[Sequence[str]] = None,
+                 name: str = ""):
+        super().__init__(name)
+        from avenir_tpu.models.correlation import STATS
+        if algorithm not in STATS:
+            raise ValueError(
+                f"unknown algorithm {algorithm!r}; known: {sorted(STATS)}")
+        self.algorithm = algorithm
+        self.src = src
+        self.dst = dst
+        self.against_class = against_class
+        self.feature_names = feature_names
+        self.needs_bin = against_class
+        self.needs_pairs = not against_class
+
+    def _pair_list(self, f: int) -> List[Tuple[int, int]]:
+        """The fit contract's (src × dst, i < j) pair selection — the ONE
+        construction shared by required_pairs and finalize."""
+        src_idx = list(self.src) if self.src is not None else list(range(f))
+        dst_idx = list(self.dst) if self.dst is not None else list(range(f))
+        return [(i, j) for i in src_idx for j in dst_idx if i < j]
+
+    def required_pairs(self, num_binned: int) -> List[Tuple[int, int]]:
+        return [] if self.against_class else self._pair_list(num_binned)
+
+    def finalize(self, t: ScanTables):
+        from avenir_tpu.models import correlation as corr
+
+        meta = t.meta
+        f, b, c = meta.num_binned, meta.max_bins, meta.num_classes
+        names = (list(self.feature_names) if self.feature_names is not None
+                 else [f"f{o}" for o in meta.binned_ordinals])
+        if self.against_class:
+            src_idx = list(self.src) if self.src is not None else list(range(f))
+            pairs = [(i, -1) for i in src_idx]
+            pair_names = [(names[i], "class") for i in src_idx]
+            b_dst = max(b, c)
+            cont = np.zeros((len(pairs), b_dst, b_dst),
+                            t.fbc.dtype if t.fbc is not None else np.int64)
+            if t.fbc is not None:
+                cont[:, :b, :c] = t.fbc[src_idx]
+        else:
+            pairs = self._pair_list(f)
+            pair_names = [(names[i], names[j]) for i, j in pairs]
+            pos = t.pair_pos()
+            if pairs:
+                sel = np.array([pos[p] for p in pairs], np.int64)
+                cont = t.pcc[sel].sum(axis=-1)           # [P, B, B] int64
+            else:
+                cont = np.zeros((0, b, b), np.int64)
+        return corr.result_from_counts(self.algorithm, pairs, pair_names,
+                                       cont, meta.n_bins, meta.num_classes)
+
+
+class FisherConsumer(ScanConsumer):
+    """Univariate Fisher discriminant from the fused continuous moments —
+    ``fisher.model_from_moments`` over the same ``class_moments`` sums the
+    standalone fit accumulates."""
+
+    needs_moments = True
+
+    def finalize(self, t: ScanTables):
+        from avenir_tpu.models import fisher
+
+        if t.moments is None:
+            raise ScanError("Fisher consumer requires continuous features")
+        cnt, s1, s2 = t.moments
+        return fisher.model_from_moments(list(t.meta.class_values),
+                                         cnt, s1, s2)
+
+
+class MomentsConsumer(ScanConsumer):
+    """Raw per-class (count, Σx, Σx²) totals of the continuous block — the
+    NumericalAttrStats-shaped statistics of the scanned stream, served from
+    the same fused moment op without another pass."""
+
+    needs_moments = True
+
+    def finalize(self, t: ScanTables):
+        if t.moments is None:
+            raise ScanError("Moments consumer requires continuous features")
+        return t.moments
+
+
+class SharedScan:
+    """Consumer registry + one-pass dispatch over an encoded chunk stream.
+
+    ``run(data)`` streams the chunks ONCE.  Per chunk it computes only what
+    the registered consumers collectively need — the co-occurrence gram
+    (kernel fast path, sharded-kernel mesh path, or the einsum fallback —
+    the SAME three-way routing as ``MutualInformation.fit``) and/or the
+    continuous class moments, fused into one dispatch on the kernel path —
+    and accumulates 64-bit host totals.  Returns ``{consumer.name: result}``.
+    """
+
+    def __init__(self, mesh=None, pair_chunk: int = 256):
+        self.mesh = mesh
+        self.pair_chunk = pair_chunk
+        self._consumers: List[ScanConsumer] = []
+
+    def register(self, consumer: ScanConsumer) -> ScanConsumer:
+        if any(c.name == consumer.name for c in self._consumers):
+            raise ScanError(f"duplicate consumer name {consumer.name!r}")
+        self._consumers.append(consumer)
+        return consumer
+
+    @property
+    def consumers(self) -> List[ScanConsumer]:
+        return list(self._consumers)
+
+    def run(self, data: Union[EncodedDataset, Iterable[EncodedDataset]]
+            ) -> Dict[str, Any]:
+        if not self._consumers:
+            raise ScanError("no consumers registered")
+        from avenir_tpu.ops import pallas_hist
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+
+        meta, chunks = peek_chunks(data)
+        if meta.labels is None:
+            raise ScanError(
+                "SharedScan requires labels: every shared table is "
+                "class-conditioned (see the row-validity contract)")
+        f, b, c = meta.num_binned, meta.max_bins, meta.num_classes
+        needs_counts = any(x.needs_bin or x.needs_pairs
+                           for x in self._consumers) and f > 0 and b > 0
+        needs_moments = any(x.needs_moments
+                            for x in self._consumers) and meta.num_cont > 0
+        # union of the pairs any consumer reads, in sorted (i, j) order —
+        # for an MI consumer that IS the all-i<j row-major index; a
+        # correlation stage restricted to a few pairs aggregates only those
+        union = sorted({p for x in self._consumers
+                        for p in x.required_pairs(f)})
+        pair_index = (np.array(union, np.int32).reshape(-1, 2) if union
+                      else np.zeros((0, 2), np.int32))
+        needs_pairs = bool(union)
+        # count-path routing: single source of truth with the standalone
+        # fast paths (MutualInformation.fit / bench.py / e2e_pipeline)
+        step = sharded = None
+        if needs_counts:
+            if pallas_hist.use_kernel(f, b, c, mesh=self.mesh):
+                step = "kernel"
+            elif (pallas_hist.applicable(f, b, c)
+                    and pallas_hist.mesh_on_tpu(self.mesh)):
+                from avenir_tpu.parallel import collectives
+                sharded = collectives.sharded_cooc_step(self.mesh, b, c)
+                step = "sharded"
+            else:
+                step = "einsum"
+        gk = pallas_hist.g_key(f, b, c)
+        acc = agg.Accumulator()
+        rows = 0
+        for ds in chunks:
+            rows += ds.num_rows
+            codes, labels, cont = maybe_shard_batch(
+                self.mesh, ds.codes, ds.labels, ds.cont)
+            acc.add("class", agg.class_counts(labels, c))
+            moments_done = False
+            if step == "kernel":
+                if needs_moments:
+                    # one fused dispatch: gram + moments of the resident chunk
+                    g, cnt, s1, s2 = pallas_hist.gram_moments(
+                        codes, labels, cont, b, c)
+                    acc.add(gk, g)
+                    acc.add("cont_count", cnt)
+                    acc.add("cont_sum", s1)
+                    acc.add("cont_sumsq", s2)
+                    moments_done = True
+                else:
+                    acc.add(gk, pallas_hist.cooc_counts(codes, labels, b, c))
+            elif step == "sharded":
+                acc.add(gk, sharded(codes, labels))
+            elif step == "einsum":
+                acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
+                for s in range(0, len(pair_index), self.pair_chunk):
+                    sl = pair_index[s:s + self.pair_chunk]
+                    acc.add(f"pcc{s}", agg.pair_class_counts(
+                        codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b))
+            if needs_moments and not moments_done:
+                cnt, s1, s2 = agg.class_moments(cont, labels, c)
+                acc.add("cont_count", cnt)
+                acc.add("cont_sum", s1)
+                acc.add("cont_sumsq", s2)
+        fbc = pcc = None
+        if needs_counts and gk in acc:
+            fbc, pcc = pallas_hist.counts_from_cooc(
+                acc.get(gk), f, b, c, pair_index[:, 0], pair_index[:, 1])
+        elif needs_counts:
+            fbc = acc.get("fc")
+            pcc = (np.concatenate(
+                [acc.get(f"pcc{s}")
+                 for s in range(0, len(pair_index), self.pair_chunk)])
+                if len(pair_index) else np.zeros((0, b, b, c), np.int64))
+        moments = None
+        if needs_moments and "cont_count" in acc:
+            moments = (acc.get("cont_count"), acc.get("cont_sum"),
+                       acc.get("cont_sumsq"))
+        tables = ScanTables(meta=meta, rows=rows,
+                            class_counts=acc.get("class"), fbc=fbc,
+                            pair_index=pair_index, pcc=pcc, moments=moments)
+        return {cons.name: cons.finalize(tables) for cons in self._consumers}
+
+
+# ---------------------------------------------------------------------------
+# driver-level stage fusion — the jobs the SharedScan can stand in for
+# ---------------------------------------------------------------------------
+
+FUSABLE_JOBS = ("BayesianDistribution", "MutualInformation",
+                "CramerCorrelation", "HeterogeneityReductionCorrelation")
+
+# conf keys that must agree across fused stages: they shape the shared
+# encode (schema, delimiters) and the shared stream (chunking, prefetch,
+# device-mesh policy)
+_COMPAT_KEYS = ("feature.schema.file.path", "field.delim.regex",
+                "field.delim", "stream.chunk.rows", "stream.prefetch.depth",
+                "data.parallel.auto")
+
+
+def stage_fusable(job, conf) -> bool:
+    """Can this (job name, stage conf) ride a SharedScan?  Conservative:
+    anything the fused path does not reproduce byte-for-byte — per-stage
+    opt-out, text-mode NB, per-job stream checkpointing, multi-process
+    chunk ownership — keeps the stage on its own scan."""
+    if not isinstance(job, str) or job not in FUSABLE_JOBS:
+        return False
+    if not conf.get_bool("scan.fuse", True):
+        return False
+    if conf.get("stream.checkpoint.dir"):
+        return False          # per-job durability is not composed with fusion
+    if job == "BayesianDistribution" and not conf.get_bool("tabular.input", True):
+        return False
+    if not conf.get("feature.schema.file.path"):
+        return False
+    import jax
+    try:
+        if jax.process_count() > 1:
+            return False      # round-robin chunk ownership is per-job
+    except Exception:                              # pragma: no cover
+        return False
+    return True
+
+
+def stages_compatible(confs) -> bool:
+    """Do these stage confs describe ONE scan?  Encoding/stream keys must
+    agree, and the shared schema must declare a class attribute (every
+    shared table is class-conditioned)."""
+    first = confs[0]
+    for conf in confs[1:]:
+        if any(conf.get(k) != first.get(k) for k in _COMPAT_KEYS):
+            return False
+    try:
+        from avenir_tpu.core.schema import FeatureSchema
+        schema = FeatureSchema.from_file(first.get("feature.schema.file.path"))
+    except Exception:
+        return False
+    return schema.class_field is not None
+
+
+def run_fused_stages(stages) -> Dict[str, Counters]:
+    """Execute a group of fusable pipeline stages as ONE SharedScan.
+
+    ``stages``: list of ``(name, job, input_path, output_path, conf)`` with
+    a common input and compatible confs (the driver checks both).  Builds
+    one chunk source through the jobs' existing ``encoded_data_source``
+    (native parse → encode → DeviceFeeder staging, once), registers one
+    consumer per stage, runs the scan, and writes each stage's output
+    byte-identically to its standalone job.  Returns per-stage Counters;
+    each carries a ``SharedScan`` counter group attesting the fusion."""
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import Job, write_output
+    from avenir_tpu.jobs.explore import correlation_plan, mi_output_lines
+    from avenir_tpu.models import naive_bayes as nb
+
+    first_conf = stages[0][4]
+    in_path = stages[0][2]
+    job_obj = Job()
+    schema = Job.load_schema(first_conf)
+    mesh = Job.auto_mesh(first_conf)
+    counters = {name: Counters() for name, *_ in stages}
+    enc, data, rows_fn = job_obj.encoded_data_source(
+        first_conf, in_path, counters[stages[0][0]], mesh=mesh)
+    engine = SharedScan(mesh=mesh)
+    writers = {}
+    for name, job, _inp, out_path, conf in stages:
+        if job == "BayesianDistribution":
+            engine.register(NaiveBayesConsumer(
+                laplace=conf.get_float("laplace.smoothing", 1.0), name=name))
+
+            def write_nb(model, conf=conf, out=out_path, name=name):
+                lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
+                write_output(out, lines)
+                counters[name].set("Model", "Rows", len(lines))
+
+            writers[name] = write_nb
+        elif job == "MutualInformation":
+            names_ = [schema.field_by_ordinal(fld.ordinal).name
+                      for fld in enc.binned_fields]
+            engine.register(MutualInfoConsumer(feature_names=names_,
+                                               name=name))
+
+            def write_mi(result, conf=conf, out=out_path, names_=names_):
+                write_output(out, mi_output_lines(conf, result, names_))
+
+            writers[name] = write_mi
+        else:                  # CramerCorrelation / HeterogeneityReduction...
+            src_idx, dst_idx, against_class, names_ = correlation_plan(
+                conf, schema, enc)
+            algorithm = get_job(job)._algorithm(conf)
+            engine.register(CorrelationConsumer(
+                algorithm=algorithm, src=src_idx, dst=dst_idx,
+                against_class=against_class, feature_names=names_, name=name))
+
+            def write_corr(result, conf=conf, out=out_path):
+                write_output(out, result.to_lines(delim=conf.field_delim))
+
+            writers[name] = write_corr
+    results = engine.run(data)
+    rows = rows_fn()
+    for name, _job, _inp, _out, _conf in stages:
+        writers[name](results[name])
+        counters[name].set("Records", "Processed", rows)
+        counters[name].set("SharedScan", "FusedStages", len(stages))
+        counters[name].set("SharedScan", "Scans", 1)
+    return counters

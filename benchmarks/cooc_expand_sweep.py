@@ -45,6 +45,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; module-local alias,
+# same as ops/pallas_hist.py
+COMPILER_PARAMS = (pltpu.CompilerParams if hasattr(pltpu, "CompilerParams")
+                   else pltpu.TPUCompilerParams)
+
+
 _INVALID = -(1 << 20)
 _PAD_SEL = -(1 << 20) - 1
 
@@ -189,7 +195,7 @@ def cooc_variant(codes, labels, num_bins, num_classes, bn, variant,
             out_specs=pl.BlockSpec((wp, wp), lambda i: (0, 0),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((wp, wp), jnp.int32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=COMPILER_PARAMS(
                 dimension_semantics=("arbitrary",),
                 vmem_limit_bytes=110 * 1024 * 1024),
             interpret=interpret,
@@ -213,7 +219,7 @@ def cooc_variant(codes, labels, num_bins, num_classes, bn, variant,
         out_specs=pl.BlockSpec((wp, wp), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((wp, wp), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,

@@ -8,7 +8,11 @@ chunked CSV parsing through the C++ data plane (runtime/native) overlapped
 with the jitted count kernels on chip.
 
 Usage: python -m benchmarks.e2e_pipeline [n_rows]   (default 20M)
-Prints one JSON line with end-to-end rows/sec and the ingest-only rate.
+Prints one JSON line with end-to-end rows/sec, the ingest-only rate, and —
+round 7 — the fused-vs-unfused wall for a 3-job (NB + MI + Cramér) pipeline
+over the same dataset: unfused pays one full scan per job, the SharedScan
+(``pipeline/scan.py``) pays one scan total, with byte-identical models
+asserted inline.
 """
 
 import json
@@ -112,6 +116,50 @@ def main():
         dt = min(dt, time.perf_counter() - t0)
     total = n_blocks * block_rows
 
+    # fused-vs-unfused 3-job pipeline (round 7): NB + MI + Cramér over the
+    # SAME dataset.  Unfused = the reference's one-Tool-per-statistic shape
+    # (each fit re-parses, re-encodes, re-uploads and re-aggregates the
+    # stream); fused = pipeline/scan.SharedScan — one encode + one gram
+    # pass serving all three consumers.  ``scan_seconds`` is the wall spent
+    # scanning (parse+encode+device aggregation), the quantity the fusion
+    # divides by K.
+    from avenir_tpu.models.correlation import CramerCorrelation
+    from avenir_tpu.models.mutual_info import MutualInformation
+    from avenir_tpu.models.naive_bayes import NaiveBayes
+    from avenir_tpu.pipeline import scan as shared_scan
+
+    fuse_blocks = max(min(n_blocks, 4_000_000 // block_rows), 1)
+
+    def chunk_stream():
+        for _ in range(fuse_blocks):
+            yield native.encode_bytes(block, enc, ncols=ncols)
+
+    per_job = {}
+    t0 = time.perf_counter()
+    nb_model = NaiveBayes().fit(chunk_stream())
+    per_job["nb"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    mi_result = MutualInformation().fit(chunk_stream())
+    per_job["mi"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cr_result = CramerCorrelation().fit(chunk_stream(), against_class=True)
+    per_job["cramer"] = time.perf_counter() - t0
+    unfused_s = sum(per_job.values())
+
+    engine = shared_scan.SharedScan()
+    engine.register(shared_scan.NaiveBayesConsumer(name="nb"))
+    engine.register(shared_scan.MutualInfoConsumer(name="mi"))
+    engine.register(shared_scan.CorrelationConsumer(name="cramer",
+                                                    against_class=True))
+    t0 = time.perf_counter()
+    fused = engine.run(chunk_stream())
+    fused_s = time.perf_counter() - t0
+    # the fused scan must reproduce the standalone jobs bit-for-bit
+    assert np.array_equal(fused["nb"].bin_counts, nb_model.bin_counts)
+    assert np.array_equal(fused["mi"].pair_class_counts,
+                          mi_result.pair_class_counts)
+    assert np.array_equal(fused["cramer"].contingency, cr_result.contingency)
+
     print(json.dumps({
         "metric": "e2e_csv_nb_mi_pipeline",
         "value": round(total / dt, 1),
@@ -120,6 +168,16 @@ def main():
         "serial_rows_per_sec": round(total / dt_serial, 1),
         "ingest_only_rows_per_sec": round(block_rows / ingest_dt, 1),
         "count_path": "pallas_cooc_int8_mxu" if kernel_path else "einsum",
+        "fused_pipeline": {
+            "jobs": ["nb", "mi", "cramer"],
+            "rows": fuse_blocks * block_rows,
+            "unfused_scan_seconds": round(unfused_s, 3),
+            "unfused_per_job_seconds": {k: round(v, 3)
+                                        for k, v in per_job.items()},
+            "fused_scan_seconds": round(fused_s, 3),
+            "scan_seconds_ratio": round(unfused_s / fused_s, 2),
+            "byte_identical": True,
+        },
     }))
 
 

@@ -300,7 +300,11 @@ def bench_cramer(passes: int, n: int = 16_000_000, f: int = 10, b: int = 20,
             "baseline_rows_per_sec": round(base, 1),
             "baseline": f"np.add.at contingency scatter over all "
                         f"{f * (f - 1) // 2} pairs on {baseline_sub} rows, "
-                        f"single core"}, vals
+                        f"single core",
+            "note": "rides the int8-only fmaj gram since round 7: plan() "
+                    "routes the one-class shape to the broadcast-expand "
+                    "layout that carries NB+MI (wp 384 vs jmaj's 256 — the "
+                    "jmaj int32 expand, not the dot, was the r05 wall)"}, vals
 
 
 def baseline_cramer(codes: np.ndarray, b: int) -> float:

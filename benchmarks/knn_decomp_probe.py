@@ -31,6 +31,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; module-local alias,
+# same as ops/pallas_hist.py
+COMPILER_PARAMS = (pltpu.CompilerParams if hasattr(pltpu, "CompilerParams")
+                   else pltpu.TPUCompilerParams)
+
+
 from avenir_tpu.ops import pallas_knn as pk
 
 
@@ -115,7 +121,7 @@ def run(a_mat, b_mat, variant):
         ],
         out_specs=[spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((m, nbp), jnp.int32)] * 3,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024),
     )(a_mat, b_mat)

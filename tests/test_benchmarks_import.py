@@ -1,0 +1,24 @@
+"""Import-smoke for every ``benchmarks/*.py`` module.
+
+The probes only run by hand on the dev rig, so they rot silently when a
+library symbol they import moves (round-7 CI satellite): importing each
+module compiles it and resolves its module-scope imports without running
+any measurement (they all gate work behind ``__main__``/``main()``)."""
+
+import importlib
+import pathlib
+
+import pytest
+
+_BENCH_DIR = pathlib.Path(__file__).resolve().parent.parent / "benchmarks"
+_MODULES = sorted(p.stem for p in _BENCH_DIR.glob("*.py")
+                  if not p.stem.startswith("_"))
+
+
+def test_benchmarks_exist():
+    assert _MODULES, f"no benchmark modules found under {_BENCH_DIR}"
+
+
+@pytest.mark.parametrize("mod", _MODULES)
+def test_benchmark_module_imports(mod):
+    importlib.import_module(f"benchmarks.{mod}")

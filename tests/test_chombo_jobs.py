@@ -259,3 +259,47 @@ def test_numerical_attr_stats_inf_input(tmp_path):
     assert float(f[2]) == float("inf")
     assert float(f[4]) == float("inf")
     assert float(f[8]) == float("inf")         # max
+
+
+def test_numerical_attr_stats_streaming_matches_whole_and_guard(tmp_path):
+    """Round-7 hardening: the streaming path's 12-digit zero-padded chunk
+    keys keep the finalize fold ordered (counts/min/max exact vs the
+    whole-input run, moments to chunked-fold tolerance — the cross-process
+    BYTE identity contract is per process count, not vs whole-input), and
+    the O(chunks × groups) state guard trips loudly instead of growing
+    without bound."""
+    from avenir_tpu.core.config import ConfigError
+
+    rng = np.random.default_rng(9)
+    rows = []
+    for _ in range(600):
+        cls = rng.choice(["a", "b", "c"])
+        rows.append(f"{rng.normal(3.0, 1.0):.5f},{cls},"
+                    f"{rng.normal(-2.0, 0.7):.5f}")
+    (tmp_path / "in").mkdir()
+    (tmp_path / "in" / "data.txt").write_text("\n".join(rows) + "\n")
+
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        JobConfig({"attr.list": "0,2", "cond.attr.ord": "1"}),
+        str(tmp_path / "in"), str(tmp_path / "out_whole"))
+    get_job("org.chombo.mr.NumericalAttrStats").run(
+        JobConfig({"attr.list": "0,2", "cond.attr.ord": "1",
+                   "stream.chunk.rows": "97"}),
+        str(tmp_path / "in"), str(tmp_path / "out_stream"))
+    whole = (tmp_path / "out_whole" / "part-00000").read_text().splitlines()
+    stream = (tmp_path / "out_stream" / "part-00000").read_text().splitlines()
+    # same rows (count/min/max exact; moments agree to fold tolerance)
+    assert len(whole) == len(stream)
+    for wl, sl in zip(sorted(whole), sorted(stream)):
+        wf, sf = wl.split(","), sl.split(",")
+        assert wf[:3] == sf[:3]                      # attr, cond, count
+        assert wf[-2:] == sf[-2:]                    # min, max exact
+        np.testing.assert_allclose([float(v) for v in wf[3:]],
+                                   [float(v) for v in sf[3:]], rtol=1e-6)
+
+    with pytest.raises(ConfigError, match="stream.stats.max.state.mb"):
+        get_job("org.chombo.mr.NumericalAttrStats").run(
+            JobConfig({"attr.list": "0,2", "cond.attr.ord": "1",
+                       "stream.chunk.rows": "50",
+                       "stream.stats.max.state.mb": "0"}),
+            str(tmp_path / "in"), str(tmp_path / "out_guard"))

@@ -1,0 +1,313 @@
+"""SharedScan engine: byte-identical equivalence against the standalone
+``fit()`` paths (single-chunk, multi-chunk streams, einsum fallback and the
+kernel fast path in interpret mode), driver-level stage fusion, and the
+DeviceFeeder abandonment contract the shared stream relies on."""
+
+import functools
+import gc
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.encoding import EncodedDataset
+from avenir_tpu.models.correlation import (CramerCorrelation,
+                                           HeterogeneityReductionCorrelation)
+from avenir_tpu.models.fisher import FisherDiscriminant
+from avenir_tpu.models.mutual_info import MutualInformation
+from avenir_tpu.models.naive_bayes import NaiveBayes
+from avenir_tpu.ops import pallas_hist
+from avenir_tpu.pipeline import scan
+
+
+N, F, B, C, FC = 3000, 5, 6, 2, 3
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    codes = rng.integers(0, B, size=(N, F)).astype(np.int32)
+    cont = rng.normal(size=(N, FC)).astype(np.float32)
+    labels = rng.integers(0, C, size=N).astype(np.int32)
+    return codes, cont, labels
+
+
+def mk_ds(data):
+    codes, cont, labels = data
+    return EncodedDataset(
+        codes=codes, cont=cont, labels=labels,
+        n_bins=np.full(F, B, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(F)),
+        cont_ordinals=list(range(F, F + FC)))
+
+
+def chunks_of(data, size=700):
+    ds = mk_ds(data)
+    return iter([ds.slice(i, min(i + size, N)) for i in range(0, N, size)])
+
+
+def build_engine():
+    eng = scan.SharedScan()
+    eng.register(scan.NaiveBayesConsumer(name="nb"))
+    eng.register(scan.MutualInfoConsumer(name="mi"))
+    eng.register(scan.CorrelationConsumer(name="cramer", against_class=True))
+    eng.register(scan.CorrelationConsumer(name="het",
+                                          algorithm="uncertaintyCoeff"))
+    eng.register(scan.FisherConsumer(name="fisher"))
+    return eng
+
+
+def assert_scan_matches_standalone(out, data, source):
+    """Byte-identical tables and identical output lines vs each model's
+    own fit() over the same chunks."""
+    nbm = NaiveBayes().fit(source())
+    np.testing.assert_array_equal(out["nb"].bin_counts, nbm.bin_counts)
+    np.testing.assert_array_equal(out["nb"].class_counts, nbm.class_counts)
+    np.testing.assert_array_equal(out["nb"].cont_count, nbm.cont_count)
+    np.testing.assert_array_equal(out["nb"].cont_sum, nbm.cont_sum)
+    np.testing.assert_array_equal(out["nb"].cont_sumsq, nbm.cont_sumsq)
+
+    mir = MutualInformation().fit(source())
+    np.testing.assert_array_equal(out["mi"].feature_class_counts,
+                                  mir.feature_class_counts)
+    np.testing.assert_array_equal(out["mi"].pair_class_counts,
+                                  mir.pair_class_counts)
+    np.testing.assert_array_equal(out["mi"].class_counts, mir.class_counts)
+    assert out["mi"].to_lines() == mir.to_lines()
+
+    crm = CramerCorrelation().fit(source(), against_class=True)
+    np.testing.assert_array_equal(out["cramer"].contingency, crm.contingency)
+    np.testing.assert_array_equal(out["cramer"].stat, crm.stat)
+    assert out["cramer"].to_lines() == crm.to_lines()
+
+    het = HeterogeneityReductionCorrelation("uncertaintyCoeff").fit(source())
+    np.testing.assert_array_equal(out["het"].contingency, het.contingency)
+    np.testing.assert_array_equal(out["het"].stat, het.stat)
+
+    fim = FisherDiscriminant().fit(source())
+    np.testing.assert_array_equal(out["fisher"].mean, fim.mean)
+    np.testing.assert_array_equal(out["fisher"].var, fim.var)
+    np.testing.assert_array_equal(out["fisher"].boundary, fim.boundary)
+
+
+def test_scan_matches_standalone_single_chunk(data):
+    out = build_engine().run(mk_ds(data))
+    assert_scan_matches_standalone(out, data, lambda: mk_ds(data))
+
+
+def test_scan_matches_standalone_multi_chunk(data):
+    out = build_engine().run(chunks_of(data))
+    assert_scan_matches_standalone(out, data, lambda: chunks_of(data))
+
+
+def test_scan_kernel_path_matches_standalone(data, monkeypatch):
+    """The kernel fast path (forced on, interpret mode, including the fused
+    gram+moments single-dispatch step) must reproduce the einsum-path
+    standalone fits byte-for-byte across a multi-chunk stream."""
+    monkeypatch.setattr(pallas_hist, "on_tpu_single_device", lambda *a: True)
+    monkeypatch.setattr(
+        pallas_hist, "cooc_counts",
+        functools.partial(pallas_hist.cooc_counts.__wrapped__,
+                          interpret=True))
+    monkeypatch.setattr(
+        pallas_hist, "gram_moments",
+        functools.partial(pallas_hist.gram_moments.__wrapped__,
+                          interpret=True))
+    out = build_engine().run(chunks_of(data))
+    # standalone comparisons run on the einsum path (kernel gates force it
+    # back off inside fit because the patched predicate applies globally —
+    # so compare against tables captured through the patched scan only for
+    # the gram; the moment comparisons exercise the fused dispatch)
+    monkeypatch.undo()
+    assert_scan_matches_standalone(out, data, lambda: chunks_of(data))
+
+
+def test_scan_subset_correlation_and_requirements(data):
+    """A correlation consumer over a src/dst subset reads the same subset
+    the standalone fit computes; an NB-only scan never builds pair
+    tensors."""
+    eng = scan.SharedScan()
+    eng.register(scan.CorrelationConsumer(name="sub", src=[0, 2], dst=[1, 3]))
+    out = eng.run(mk_ds(data))
+    ref = CramerCorrelation().fit(mk_ds(data), src=[0, 2], dst=[1, 3])
+    np.testing.assert_array_equal(out["sub"].contingency, ref.contingency)
+    np.testing.assert_array_equal(out["sub"].stat, ref.stat)
+    assert out["sub"].pairs == ref.pairs
+
+    nb_only = scan.SharedScan()
+    nb_only.register(scan.NaiveBayesConsumer(name="nb"))
+    res = nb_only.run(mk_ds(data))
+    nbm = NaiveBayes().fit(mk_ds(data))
+    np.testing.assert_array_equal(res["nb"].bin_counts, nbm.bin_counts)
+
+
+def test_scan_requires_labels_and_consumers(data):
+    codes, cont, _ = data
+    ds = EncodedDataset(codes=codes, cont=cont, labels=None,
+                        n_bins=np.full(F, B, np.int32),
+                        class_values=["a", "b"],
+                        binned_ordinals=list(range(F)))
+    eng = scan.SharedScan()
+    with pytest.raises(scan.ScanError):
+        eng.run(ds)                       # no consumers
+    eng.register(scan.NaiveBayesConsumer(name="nb"))
+    with pytest.raises(scan.ScanError):
+        eng.run(ds)                       # no labels
+    with pytest.raises(scan.ScanError):
+        eng.register(scan.NaiveBayesConsumer(name="nb"))   # duplicate name
+
+
+# ---------------------------------------------------------------------------
+# driver-level stage fusion
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def churn_pipeline_env(tmp_path_factory):
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+
+    root = tmp_path_factory.mktemp("scan_pipeline")
+    rows = generate_churn(2000, seed=11)
+    write_csv(str(root / "train.csv"), rows)
+    schema_path = root / "churn.json"
+    schema_path.write_text(json.dumps(CHURN_SCHEMA_JSON))
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    conf = JobConfig({"feature.schema.file.path": str(schema_path)})
+    return root, conf, schema
+
+
+def _count_pipeline(ws, conf, class_ord):
+    from avenir_tpu.pipeline.driver import Pipeline, Stage
+
+    p = Pipeline(str(ws), conf)
+    p.add(Stage("bayesianDistr", "BayesianDistribution", "data", "nb_model"))
+    p.add(Stage("mutualInfo", "MutualInformation", "data", "mi_out"))
+    p.add(Stage("cramer", "CramerCorrelation", "data", "cramer_out",
+                props={"dest.attributes": str(class_ord)}))
+    p.add(Stage("het", "HeterogeneityReductionCorrelation", "data", "het_out",
+                props={"heterogeneity.algorithm": "uncertainty"}))
+    return p
+
+
+@pytest.fixture(scope="module")
+def plain_outputs(churn_pipeline_env):
+    """Unfused (scan.fuse=false) reference run: artifact → part-file bytes."""
+    from avenir_tpu.core.config import JobConfig
+
+    root, conf, schema = churn_pipeline_env
+    unconf = JobConfig(dict(conf.props))
+    unconf.set("scan.fuse", "false")
+    plain = _count_pipeline(root / "ws_plain", unconf,
+                            schema.class_field.ordinal)
+    plain.bind("data", str(root / "train.csv"))
+    cp = plain.run()
+    for name in ("bayesianDistr", "mutualInfo", "cramer", "het"):
+        assert cp[name].get("SharedScan", "FusedStages") == 0
+    return {art: (root / "ws_plain" / art / "part-00000").read_bytes()
+            for art in ("nb_model", "mi_out", "cramer_out", "het_out")}
+
+
+def test_driver_fuses_count_stages_byte_identical(churn_pipeline_env,
+                                                  plain_outputs):
+    """A 4-stage NB+MI+Cramér+heterogeneity pipeline over one artifact runs
+    as ONE SharedScan, with every stage's part file byte-identical to the
+    unfused (scan.fuse=false) run."""
+    from avenir_tpu.core.config import JobConfig
+
+    root, conf, schema = churn_pipeline_env
+    class_ord = schema.class_field.ordinal
+
+    fused = _count_pipeline(root / "ws_fused", JobConfig(dict(conf.props)),
+                            class_ord)
+    fused.bind("data", str(root / "train.csv"))
+    cf = fused.run()
+    for name in ("bayesianDistr", "mutualInfo", "cramer", "het"):
+        assert cf[name].get("SharedScan", "FusedStages") == 4
+        assert cf[name].get("SharedScan", "Scans") == 1
+        assert cf[name].get("Records", "Processed") == 2000
+
+    for art, expect in plain_outputs.items():
+        a = (root / "ws_fused" / art / "part-00000").read_bytes()
+        assert a == expect, f"fused {art} differs from standalone output"
+
+
+def test_driver_per_stage_opt_out_breaks_group(churn_pipeline_env,
+                                               plain_outputs):
+    """scan.fuse=false on ONE stage keeps it on its own scan; the
+    remaining consecutive stages still fuse, and outputs stay identical."""
+    from avenir_tpu.core.config import JobConfig
+
+    root, conf, schema = churn_pipeline_env
+    class_ord = schema.class_field.ordinal
+    p = _count_pipeline(root / "ws_optout", JobConfig(dict(conf.props)),
+                        class_ord)
+    p.stages[1].props["scan.fuse"] = "false"       # mutualInfo opts out
+    p.bind("data", str(root / "train.csv"))
+    c = p.run()
+    assert c["bayesianDistr"].get("SharedScan", "FusedStages") == 0
+    assert c["mutualInfo"].get("SharedScan", "FusedStages") == 0
+    assert c["cramer"].get("SharedScan", "FusedStages") == 2
+    assert c["het"].get("SharedScan", "FusedStages") == 2
+    for art, expect in plain_outputs.items():
+        assert (root / "ws_optout" / art / "part-00000").read_bytes() == expect
+
+
+def test_driver_fusion_streaming_chunks(churn_pipeline_env, plain_outputs):
+    """Fusion composes with the chunked stream (stream.chunk.rows): one
+    DeviceFeeder-staged stream, same bytes out."""
+    from avenir_tpu.core.config import JobConfig
+
+    root, conf, schema = churn_pipeline_env
+    sconf = JobConfig(dict(conf.props))
+    sconf.set("stream.chunk.rows", "700")
+    p = _count_pipeline(root / "ws_stream", sconf, schema.class_field.ordinal)
+    p.bind("data", str(root / "train.csv"))
+    c = p.run()
+    assert c["mutualInfo"].get("SharedScan", "FusedStages") == 4
+    for art, expect in plain_outputs.items():
+        assert (root / "ws_stream" / art / "part-00000").read_bytes() == expect
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeeder abandonment (the shared stream's failure contract)
+# ---------------------------------------------------------------------------
+
+def test_device_feeder_abandonment_stops_worker():
+    """Consumer raises mid-stream and drops the feeder: the worker thread
+    must stop (not spin through the whole source) and no staged buffers
+    stay pinned."""
+    from avenir_tpu.runtime.feeder import DeviceFeeder
+
+    produced = []
+
+    def source():
+        for i in range(100_000):
+            produced.append(i)
+            yield i
+
+    feeder = DeviceFeeder(source(), depth=2, stage=lambda x: x)
+    worker = feeder._thread
+    it = iter(feeder)
+    next(it)
+    with pytest.raises(RuntimeError):
+        raise RuntimeError("consumer failure mid-stream")
+    del feeder, it                        # abandoned, never exhausted
+    gc.collect()
+    worker.join(timeout=10.0)
+    assert not worker.is_alive()
+    assert len(produced) < 100_000        # stopped early, not drained
+
+
+def test_device_feeder_close_drops_staged_buffers():
+    from avenir_tpu.runtime.feeder import DeviceFeeder
+
+    feeder = DeviceFeeder(iter(range(100)), depth=4, stage=lambda x: x)
+    next(iter(feeder))
+    feeder.close()
+    assert not feeder._thread.is_alive()
+    assert feeder._q.empty()              # staged-but-unconsumed dropped
+    with pytest.raises(StopIteration):
+        next(iter(feeder))
