@@ -1,0 +1,34 @@
+"""graftlint — AST-based hazard analysis for the avenir_tpu codebase.
+
+Every advisor round found the same *classes* of bug by hand: a
+process-divergent value flowing into a collective (ADVICE.md round 5,
+``jobs/regress.py``), checkpoint state that doesn't fingerprint its
+configuration (``models/correlation.py``), fixed-width format keys that
+silently mis-sort past their width (``jobs/chombo.py``), config keys that
+exist in code but not in ``docs/jobs.md``, and per-chunk host syncs that
+turn compiled loops into RTT walls (the round-5 tree-induction wall).
+These are exactly the invariants a compiler-first stack should check
+mechanically — DrJAX gets its MapReduce correctness from making sharded
+structure visible to the compiler; this package makes the *process
+structure* visible to a static pass, so the invariants hold at authoring
+time instead of at 2am in a multi-process run.
+
+Usage::
+
+    python -m avenir_tpu.analysis [paths...]        # lint (default tree)
+    python -m avenir_tpu.analysis --json ...        # machine-readable
+    python -m avenir_tpu.analysis --write-baseline  # grandfather findings
+    python -m avenir_tpu.analysis --write-registry  # regen config registry
+
+Per-line suppression: ``# graftlint: disable=GL005`` (same line, or alone
+on the line above) with a comment saying why.  Grandfathered findings live
+in ``avenir_tpu/analysis/baseline.json`` with a ``why`` per entry.
+
+Pure stdlib — importing this package must never pull in jax (the lint gate
+runs in CI before any device work).
+"""
+
+from avenir_tpu.analysis.engine import Finding, run_paths  # noqa: F401
+from avenir_tpu.analysis.rules import RULES  # noqa: F401
+
+__all__ = ["Finding", "run_paths", "RULES"]

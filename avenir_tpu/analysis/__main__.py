@@ -1,0 +1,87 @@
+"""graftlint CLI — ``python -m avenir_tpu.analysis [paths...]``.
+
+Emits ``file:line: RULE message`` per finding (or a JSON array with
+``--json``) and exits non-zero when any non-baselined finding remains.
+Run from the repo root (paths in the baseline and registry are
+root-relative).  Stdlib-only: never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from avenir_tpu.analysis import engine, registry_gen
+
+DEFAULT_PATHS = ("avenir_tpu", "benchmarks", "bench.py")
+DEFAULT_DOC_PATHS = ("docs", "README.md")
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m avenir_tpu.analysis",
+        description="graftlint — AST hazard analysis (GL001–GL005)")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: "
+                         f"{' '.join(DEFAULT_PATHS)} when present)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--baseline", default=engine.BASELINE_PATH,
+                    help="baseline file (default: the checked-in one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show every finding)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print grandfathered findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather all current findings (then fill in "
+                         "each entry's 'why')")
+    ap.add_argument("--write-registry", action="store_true",
+                    help="regenerate analysis/config_registry.py from the "
+                         "code + docs trees")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
+    if not paths:
+        ap.error("no paths given and none of the defaults exist "
+                 f"({', '.join(DEFAULT_PATHS)}) — run from the repo root")
+
+    if args.write_registry:
+        registry = registry_gen.write_registry(
+            paths, [p for p in DEFAULT_DOC_PATHS if os.path.exists(p)])
+        undoc = sorted(k for k, v in registry.items() if v is None)
+        print(f"wrote {registry_gen.REGISTRY_PATH}: "
+              f"{len(registry)} keys, {len(undoc)} undocumented"
+              + (f" ({', '.join(undoc)})" if undoc else ""))
+        return 0
+
+    baseline = None if args.no_baseline else args.baseline
+    findings = engine.run_paths(paths, baseline_path=baseline)
+
+    if args.write_baseline:
+        existing = engine.load_baseline(
+            args.baseline if os.path.exists(args.baseline) else None)
+        engine.write_baseline(args.baseline, findings, existing=existing)
+        n_new = sum(1 for f in findings if not f.baselined)
+        print(f"wrote {args.baseline}: {n_new} new entr"
+              f"{'y' if n_new == 1 else 'ies'} (existing whys preserved) — "
+              f"fill in each new 'why' before committing")
+        return 0
+
+    live = [f for f in findings if not f.baselined]
+    shown = findings if args.show_baselined else live
+    if args.json:
+        print(json.dumps([f.as_dict() for f in shown], indent=2))
+    else:
+        for f in shown:
+            print(f.format())
+        n_base = sum(1 for f in findings if f.baselined)
+        print(f"graftlint: {len(live)} finding(s), {n_base} baselined",
+              file=sys.stderr)
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

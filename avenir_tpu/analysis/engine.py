@@ -1,0 +1,191 @@
+"""graftlint engine — file walking, suppression comments, baseline filtering.
+
+The engine is rule-agnostic: it parses each ``.py`` file once, hands the
+tree to every registered rule (``avenir_tpu/analysis/rules.py``), then
+applies the two escape hatches in order:
+
+1. **suppression comments** — ``# graftlint: disable=GL001[,GL002]`` on the
+   finding's line (or alone on the line directly above it) drops the
+   finding at the source; the comment is expected to say why.
+   ``# graftlint: disable-file=GL004`` anywhere in a file's first 20 lines
+   disables a rule for the whole file.
+2. **baseline** — ``baseline.json`` grandfathers known findings by
+   ``(rule, path, message)`` (line numbers are deliberately excluded so
+   unrelated edits don't churn the baseline); each entry carries a ``why``.
+
+Everything here is stdlib-only: the lint gate must run (and fail fast)
+without importing jax or touching a device.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9, ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Z0-9, ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.  ``path`` is root-relative POSIX (stable across
+    machines — the baseline and CI compare these)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    baselined: bool = False
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line number excluded so edits above a
+        grandfathered finding don't invalidate its entry."""
+        return (self.rule, self.path, self.message)
+
+    def format(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "baselined": self.baselined}
+
+
+def _parse_rule_list(text: str) -> Set[str]:
+    return {r.strip() for r in text.split(",") if r.strip()}
+
+
+def suppressions(src: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(line → suppressed rules, file-wide suppressed rules).
+
+    A ``disable=`` comment applies to its own line; when the line holds
+    nothing but the comment it applies to the next line instead (the
+    conventional place for a suppression with a why-comment above the
+    flagged statement).  Findings anchor at the statement's first line, so
+    multi-line calls take the comment on (or above) that first line.
+    """
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    lines = src.splitlines()
+    for i, line in enumerate(lines, start=1):
+        if i <= 20:
+            mf = _SUPPRESS_FILE_RE.search(line)
+            if mf:
+                file_wide |= _parse_rule_list(mf.group(1))
+                continue
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = _parse_rule_list(m.group(1))
+        target = i + 1 if line.strip().startswith("#") else i
+        per_line.setdefault(target, set()).update(rules)
+    return per_line, file_wide
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        elif p.endswith(".py"):
+            yield p
+
+
+def load_baseline(path: Optional[str]) -> List[dict]:
+    if path is None or not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        data = json.load(fh)
+    entries = data.get("findings", [])
+    for e in entries:
+        if not e.get("why"):
+            raise ValueError(
+                f"baseline entry {e.get('rule')}:{e.get('path')} has no "
+                f"'why' — every grandfathered finding must say why it is "
+                f"acceptable (or be fixed instead)")
+    return entries
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   existing: Sequence[dict] = ()) -> None:
+    """Grandfather the current findings: existing entries that still match
+    a finding keep their curated ``why`` (an entry whose finding was fixed
+    is dropped — the whole-tree test enforces that staleness anyway); new
+    non-baselined findings get stub ``why`` fields the author must fill in
+    (load_baseline rejects empty ones)."""
+    live_keys = {f.key for f in findings}
+    kept = [e for e in existing
+            if (e["rule"], e["path"], e["message"]) in live_keys]
+    kept_keys = {(e["rule"], e["path"], e["message"]) for e in kept}
+    fresh = [{"rule": f.rule, "path": f.path, "message": f.message,
+              "why": "FILL ME IN — why is this finding acceptable?"}
+             for f in sorted(findings, key=lambda f: (f.path, f.line))
+             if f.key not in kept_keys]
+    entries = sorted(kept + fresh, key=lambda e: (e["path"], e["rule"]))
+    with open(path, "w") as fh:
+        json.dump({"findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def lint_file(path: str, relpath: str, rules=None,
+              config_keys: Optional[dict] = None) -> List[Finding]:
+    """All findings for one file, suppression comments already applied."""
+    from avenir_tpu.analysis.rules import RULES, RuleContext
+
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("GL000", relpath, e.lineno or 1,
+                        f"file does not parse: {e.msg}")]
+    per_line, file_wide = suppressions(src)
+    ctx = RuleContext(src=src, relpath=relpath, config_keys=config_keys)
+    out: List[Finding] = []
+    for rule_id, rule_fn in (rules or RULES).items():
+        if rule_id in file_wide:
+            continue
+        for line, message in rule_fn(tree, ctx):
+            if rule_id in per_line.get(line, ()):
+                continue
+            out.append(Finding(rule_id, relpath, line, message))
+    return out
+
+
+def run_paths(paths: Sequence[str], root: Optional[str] = None,
+              baseline_path: Optional[str] = BASELINE_PATH,
+              rules=None, config_keys: Optional[dict] = None) -> List[Finding]:
+    """Lint ``paths`` (files or directories); returns findings sorted by
+    (path, line) with baselined ones flagged, not dropped — callers decide
+    whether to show them (CI fails only on non-baselined findings)."""
+    root = os.path.abspath(root or os.getcwd())
+    baseline = {(e["rule"], e["path"], e["message"])
+                for e in load_baseline(baseline_path)}
+    findings: List[Finding] = []
+    for path in _iter_py_files([os.fspath(p) for p in paths]):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, root) if ap.startswith(root + os.sep) \
+            else ap
+        rel = rel.replace(os.sep, "/")
+        findings.extend(lint_file(ap, rel, rules=rules,
+                                  config_keys=config_keys))
+    # dedupe (two identical format specs on one line report once), then
+    # flag baselined entries
+    findings = [
+        Finding(f.rule, f.path, f.line, f.message,
+                baselined=f.key in baseline)
+        for f in dict.fromkeys(findings)
+    ]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,438 @@
+"""graftlint rules GL001–GL005 — each encodes a bug class an advisor round
+found by hand in THIS repo (see docs/analysis.md for the history and
+ADVICE.md citations).
+
+All rules are pure-AST (stdlib ``ast`` only) and deliberately scoped to the
+patterns this codebase actually uses, trading generality for a near-zero
+false-positive rate: a lint gate that cries wolf gets suppressed wholesale
+and protects nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+RuleResult = List[Tuple[int, str]]          # (line, message)
+
+
+@dataclass
+class RuleContext:
+    src: str
+    relpath: str
+    # GL004: key → doc location (None = undocumented); None = load default
+    config_keys: Optional[dict] = None
+
+
+# ---------------------------------------------------------------------------
+# shared AST plumbing
+# ---------------------------------------------------------------------------
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._gl_parent = node          # type: ignore[attr-defined]
+
+
+def _ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "_gl_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_gl_parent", None)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.device_get' for Attribute/Name chains; None for anything else
+    (calls on call results, subscripts, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _functions(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for anc in _ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _in_loop(node: ast.AST, stop_at: Optional[ast.AST] = None) -> bool:
+    for anc in _ancestors(node):
+        if anc is stop_at:
+            return False
+        if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+    return False
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:                         # pragma: no cover
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# GL001 — collective divergence
+# ---------------------------------------------------------------------------
+
+# the multi-process merge seams (parallel/mesh.py, jax multihost utils): a
+# value that differs across processes must never be computed on the path
+# into one of these without either a writer guard (process 0 computes, the
+# collective itself broadcasts) or the error-through-the-collective pattern
+_GL001_SINKS = ("all_process_sum_state", "process_allgather",
+                "broadcast_one_to_all")
+
+# process-divergent value producers: unlocked file reads, env, clocks, RNG,
+# and per-process checkpoint restores
+_GL001_SOURCE_CALLS = {"open", "load_state"}
+_GL001_SOURCE_DOTTED_PREFIXES = (
+    "os.environ", "os.getenv", "time.time", "time.monotonic",
+    "time.perf_counter", "random.", "np.random.", "numpy.random.",
+)
+_GL001_SOURCE_METHOD_SUFFIXES = (".restore",)
+
+_GL001_GUARDS = ("is_output_writer", "process_index", "process_count",
+                 "nprocs")
+
+
+def _gl001_is_source(call: ast.Call) -> Optional[str]:
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    if dotted in _GL001_SOURCE_CALLS:
+        return dotted
+    for prefix in _GL001_SOURCE_DOTTED_PREFIXES:
+        if dotted == prefix.rstrip(".") or dotted.startswith(prefix):
+            return dotted
+    for suffix in _GL001_SOURCE_METHOD_SUFFIXES:
+        if dotted.endswith(suffix):
+            return dotted
+    return None
+
+
+def _gl001_guarded(node: ast.AST, fn: ast.AST) -> bool:
+    for anc in _ancestors(node):
+        if anc is fn:
+            return False
+        if isinstance(anc, ast.If) and any(
+                g in _unparse(anc.test) for g in _GL001_GUARDS):
+            return True
+    return False
+
+
+def check_gl001(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """Process-divergent value (unlocked read / env / clock / RNG /
+    per-process restore) computed in a function that enters a cross-process
+    collective, without a writer guard.  The regress.py round-5 bug class:
+    peers read the LR coefficient file independently of the writer's locked
+    read, then entered the gradient collective with different resume
+    weights (ADVICE.md r5 #1)."""
+    _attach_parents(tree)
+    out: RuleResult = []
+    for fn in _functions(tree):
+        has_sink = any(
+            isinstance(n, ast.Call)
+            and (_dotted(n.func) or "").split(".")[-1] in _GL001_SINKS
+            for n in ast.walk(fn))
+        if not has_sink:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _enclosing_function(node) is not fn:
+                continue                     # belongs to a nested function
+            src_name = _gl001_is_source(node)
+            if src_name is None or _gl001_guarded(node, fn):
+                continue
+            out.append((node.lineno, (
+                f"process-divergent value from {src_name}() computed in a "
+                f"function that enters a cross-process collective "
+                f"({'/'.join(_GL001_SINKS[:2])}) without a writer guard — "
+                f"route it through process 0 + the broadcast handshake "
+                f"(jobs/regress.py::_broadcast_resume pattern)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL002 — unfingerprinted checkpoint/accumulator keys
+# ---------------------------------------------------------------------------
+
+_GL002_IDENTITY_HINTS = ("run", "fingerprint", "fp", "key", "id", "meta",
+                         "schema")
+
+
+def check_gl002(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """Checkpoint/accumulator state that doesn't fingerprint the
+    configuration that produced it.  The correlation.py round-5 bug class:
+    einsum-path keys named only c0, c256, ... restored cleanly after the
+    attribute lists changed, silently summing incompatible pair counts
+    (ADVICE.md r5 #3 — fixed in PR 1 by the `_einsum_key_prefix`
+    fingerprint).
+
+    Pattern A: a dict literal passed to a checkpoint ``save`` whose keys
+    carry no identity/fingerprint component (``run``/``id``/...).
+    Pattern B: an f-string accumulator key whose literal part is a bare
+    1–3 letter tag and whose placeholders are plain loop indices — no
+    fingerprint variable qualifies the key family.
+    """
+    _attach_parents(tree)
+    out: RuleResult = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ""
+        tail = dotted.split(".")[-1]
+        receiver = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+        # -- pattern A: snapshot dict without an identity key -------------
+        if tail in ("save", "save_state") and (
+                "save_state" in dotted or "mgr" in receiver
+                or "manager" in receiver or "checkpoint" in receiver):
+            for arg in node.args:
+                if not isinstance(arg, ast.Dict):
+                    continue
+                keys = [k.value for k in arg.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                if keys and not any(
+                        h in k for k in keys for h in _GL002_IDENTITY_HINTS):
+                    out.append((arg.lineno, (
+                        f"checkpoint snapshot dict {{{', '.join(keys)}}} "
+                        f"carries no run/config identity key — a stale "
+                        f"snapshot from another configuration restores "
+                        f"silently (models/correlation.py r5 bug class); "
+                        f"add a fingerprint entry and validate on restore")))
+        # -- pattern B: bare-index accumulator key family -----------------
+        if tail == "add" and "acc" in dotted.split(".")[0].lower() and \
+                node.args and isinstance(node.args[0], ast.JoinedStr):
+            key = node.args[0]
+            first = key.values[0] if key.values else None
+            if (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and re.fullmatch(r"[a-z]{1,3}", first.value)
+                    and all(isinstance(v, (ast.Constant, ast.FormattedValue))
+                            for v in key.values)):
+                out.append((key.lineno, (
+                    f"accumulator key {_unparse(key)!r} is a bare "
+                    f"tag+index with no configuration fingerprint "
+                    f"component — a checkpoint restored under a different "
+                    f"configuration produces the same key names and sums "
+                    f"incompatible partials; qualify the key family like "
+                    f"models/correlation.py::_einsum_key_prefix")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL003 — fixed-width format keys without a bound assert
+# ---------------------------------------------------------------------------
+
+_WIDTH_RE = re.compile(r"^0(\d+)d$")
+
+
+def _gl003_has_bound_check(scope: ast.AST, width: int) -> bool:
+    """True when the enclosing scope compares something against 10**width
+    (either spelling) — the loud-failure guard that keeps lexicographic
+    order == numeric order inside the key width."""
+    bound = 10 ** width
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Constant) and node.value == bound:
+            return True
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow)
+                and isinstance(node.left, ast.Constant)
+                and node.left.value == 10
+                and isinstance(node.right, ast.Constant)
+                and node.right.value == width):
+            return True
+    return False
+
+
+def check_gl003(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """``{x:0Nd}`` fixed-width keys with no adjacent 10**N bound check.
+    The chombo.py round-5 bug class: ``c{idx:08d}`` snapshot keys silently
+    mis-ordered the ascending-key finalize fold past 10^8 chunks
+    (ADVICE.md r5 #4 — the fixed path now asserts ``idx < 10**12``).
+    Sorted folds, directory names, and generated ids all merge or list
+    lexicographically, so a value past the width reorders silently."""
+    _attach_parents(tree)
+    out: RuleResult = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FormattedValue) or \
+                node.format_spec is None:
+            continue
+        spec = "".join(
+            v.value for v in node.format_spec.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str))
+        m = _WIDTH_RE.match(spec)
+        if not m:
+            continue
+        width = int(m.group(1))
+        scope = _enclosing_function(node) or tree
+        if _gl003_has_bound_check(scope, width):
+            continue
+        out.append((node.lineno, (
+            f"fixed-width key format ':{spec}' has no adjacent 10**{width} "
+            f"bound check — values past the width silently break "
+            f"lexicographic==numeric ordering (jobs/chombo.py r5 bug "
+            f"class); assert/raise against 10**{width} in the same "
+            f"function, or widen the field")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL004 — config keys outside the generated registry / undocumented
+# ---------------------------------------------------------------------------
+
+_CONF_GETTERS = {"get", "get_int", "get_float", "get_bool", "get_list",
+                 "get_int_list", "get_float_list"}
+
+
+def iter_conf_key_calls(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+    """(line, key) for every ``conf.get*("literal")`` call — shared by the
+    GL004 check and the registry generator so they can never disagree on
+    what counts as a config-key read."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CONF_GETTERS):
+            continue
+        dotted = _dotted(node.func) or ""
+        receiver = dotted.rsplit(".", 1)[0].split(".")[-1].lower()
+        if "conf" not in receiver and "cfg" not in receiver:
+            continue                    # dict.get(...) etc, not a JobConfig
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            yield node.args[0].lineno, node.args[0].value
+
+
+def _default_config_keys() -> dict:
+    try:
+        from avenir_tpu.analysis.config_registry import CONFIG_KEYS
+        return CONFIG_KEYS
+    except ImportError:                      # registry not generated yet
+        return {}
+
+
+def check_gl004(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """Every ``conf.get*("…")`` literal must exist in the generated
+    ``analysis/config_registry.py`` AND be documented in docs/.  The drift
+    this catches: keys like ``class.condtion.weighted`` (the reference's
+    own typo, kept for compat) living in code with no doc trail, so config
+    written against docs/jobs.md silently does nothing."""
+    registry = ctx.config_keys if ctx.config_keys is not None \
+        else _default_config_keys()
+    out: RuleResult = []
+    for line, key in iter_conf_key_calls(tree):
+        if key not in registry:
+            out.append((line, (
+                f"unknown config key {key!r} — not in "
+                f"analysis/config_registry.py; regenerate with "
+                f"`python -m avenir_tpu.analysis --write-registry` and "
+                f"document the key in docs/jobs.md")))
+        elif registry[key] is None:
+            out.append((line, (
+                f"config key {key!r} is undocumented — no docs/*.md "
+                f"mentions it; add it to docs/jobs.md and regenerate the "
+                f"registry")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL005 — host sync inside a hot loop
+# ---------------------------------------------------------------------------
+
+_GL005_SYNC_DOTTED = {"jax.device_get", "jax.block_until_ready"}
+_GL005_FETCHERS = {"float", "int", "np.asarray", "np.array",
+                   "numpy.asarray", "numpy.array"}
+_GL005_DEVICE_PREFIXES = ("jnp.", "jax.lax.", "jax.nn.", "lax.")
+
+
+def _gl005_on_host(node: ast.AST) -> bool:
+    for anc in _ancestors(node):
+        if isinstance(anc, ast.With) and any(
+                "on_host" in _unparse(item.context_expr)
+                for item in anc.items):
+            return True
+    return False
+
+
+def check_gl005(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """``.item()`` / ``jax.device_get`` / ``float(traced)`` /
+    ``np.asarray(traced)`` inside a ``for``/``while`` loop: each iteration
+    pays a full host↔device round trip, serializing the pipeline — the
+    round-5 tree-induction wall (~100 ms RTT × depth capped induction at
+    0.21× sklearn until PR 1 moved selection on-device).  Values are
+    "traced" when assigned in the same function from a jnp./jax.lax. call;
+    ``with …on_host():`` blocks are exempt (explicit host-compute
+    escape hatch, ops/info.py)."""
+    _attach_parents(tree)
+    out: RuleResult = []
+    for fn in _functions(tree):
+        tainted = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                dotted = _dotted(node.value.func) or ""
+                if any(dotted.startswith(p)
+                       for p in _GL005_DEVICE_PREFIXES):
+                    for tgt in node.targets:
+                        for t in ast.walk(tgt):
+                            if isinstance(t, ast.Name):
+                                tainted.add(t.id)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _enclosing_function(node) is not fn:
+                continue
+            if not _in_loop(node, stop_at=fn) or _gl005_on_host(node):
+                continue
+            dotted = _dotted(node.func) or ""
+            hit = None
+            if dotted in _GL005_SYNC_DOTTED:
+                hit = dotted
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                hit = ".item()"
+            elif dotted in _GL005_FETCHERS and node.args:
+                arg = node.args[0]
+                base = arg
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                arg_dotted = _dotted(arg.func) if isinstance(arg, ast.Call) \
+                    else None
+                if (isinstance(base, ast.Name) and base.id in tainted) or \
+                        (arg_dotted and any(
+                            arg_dotted.startswith(p)
+                            for p in _GL005_DEVICE_PREFIXES)):
+                    hit = f"{dotted}(<traced>)"
+            if hit:
+                out.append((node.lineno, (
+                    f"host sync {hit} inside a loop — every iteration pays "
+                    f"a device round trip (the r05 tree-induction RTT "
+                    f"wall); batch the fetch outside the loop or keep the "
+                    f"reduction on device (models/tree.py::"
+                    f"_device_select_splits pattern)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, Callable[[ast.AST, RuleContext], RuleResult]] = {
+    "GL001": check_gl001,
+    "GL002": check_gl002,
+    "GL003": check_gl003,
+    "GL004": check_gl004,
+    "GL005": check_gl005,
+}

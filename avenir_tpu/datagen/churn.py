@@ -70,6 +70,9 @@ def generate_churn(n: int, seed: int = 42) -> np.ndarray:
     closed = rng.uniform(0, 100, size=n) < pr
 
     rows = np.empty((n, 7), dtype=object)
+    # ids are zero-padded so lexicographic order == generation order for
+    # any downstream sort/group; n past the width would break that (GL003)
+    assert n < 10 ** 10, "customer ids overflow the 10-digit width"
     rows[:, 0] = [f"C{int(i):010d}" for i in range(n)]
     rows[:, 1] = min_used
     rows[:, 2] = data_used

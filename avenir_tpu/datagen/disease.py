@@ -73,6 +73,8 @@ def generate_disease(n: int, seed: int = 0) -> np.ndarray:
     status = np.where(rng.integers(0, 100, size=n) < pr, "Yes", "No")
 
     rows = np.empty((n, 8), dtype=object)
+    # zero-padded ids: lexicographic == generation order (graftlint GL003)
+    assert n < 10 ** 11, "patient ids overflow the 11-digit width"
     rows[:, 0] = [f"P{i:011d}" for i in range(n)]
     rows[:, 1] = [str(v) for v in age]
     rows[:, 2] = race

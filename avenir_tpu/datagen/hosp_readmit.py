@@ -91,6 +91,8 @@ def generate_hosp_readmit(n: int, seed: int = 42) -> np.ndarray:
     readmit = rng.uniform(0, 100, size=n) < prob
 
     rows = np.empty((n, 12), dtype=object)
+    # zero-padded ids: lexicographic == generation order (graftlint GL003)
+    assert n < 10 ** 10, "patient ids overflow the 10-digit width"
     rows[:, 0] = [f"P{int(i):010d}" for i in range(n)]
     rows[:, 1] = age.astype(str).astype(object)
     rows[:, 2] = wt.astype(str).astype(object)

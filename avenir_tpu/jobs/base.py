@@ -674,11 +674,11 @@ class StreamCheckpointer:
 
     def __init__(self, directory: str, interval_chunks: int = 8,
                  resume: bool = False, crash_after_chunks: int = 0,
-                 parent_dir: Optional[str] = None, run_id: str = ""):
+                 parent_dir: Optional[str] = None, run_id: str = "",
+                 defer_errors: bool = False):
         from avenir_tpu.ops import agg
         from avenir_tpu.utils.checkpoint import CheckpointManager
 
-        self.mgr = CheckpointManager(directory, keep=2)
         self.directory = directory
         self.parent_dir = parent_dir         # multi-process: shared root
         self.run_id = run_id
@@ -688,39 +688,99 @@ class StreamCheckpointer:
         self.base_rows = 0
         self.start: Optional[dict] = None      # cursor to resume from
         self._consumed = 0                     # chunks consumed THIS run
-        if parent_dir is not None and run_id:
-            # tag the process subdirectory with this run's identity so the
-            # sweep in finish() can tell our stale subdirs from a live
-            # concurrent job's (the id is conf-derived, hence stable across
-            # crash + relaunch — including at a different process count)
-            os.makedirs(directory, exist_ok=True)
-            with open(os.path.join(directory, "RUN_TAG"), "w") as fh:
-                fh.write(run_id)
-        if resume:
-            state = self.mgr.restore()
-            if state is not None:
-                self.accumulator.load(state["acc"])
-                self.base_rows = int(state["rows"])
-                self.start = {k: state["cursor"][k]
-                              for k in ("file", "offset", "chunk")}
+        # construction/restore failures: raised here single-process, but in
+        # a distributed run held until _handshake_errors so the failure
+        # travels THROUGH a collective every process enters (a process that
+        # raised early would strand its peers in their next collective —
+        # the round-5 LR-resume hazard class, jobs/regress.py)
+        self.error: Optional[str] = None
+        self.mgr = None
+        try:
+            if parent_dir is not None and run_id:
+                # tag the process subdirectory with this run's identity so
+                # the sweep in finish() can tell our stale subdirs from a
+                # live concurrent job's (the id is conf-derived, hence
+                # stable across crash + relaunch — including at a different
+                # process count).  A subdirectory already tagged by a
+                # DIFFERENT run must refuse loudly (round-8 graftlint
+                # GL001/GL002 audit) BEFORE CheckpointManager touches it:
+                # its _recover() sweeps temp dirs and promotes .bak
+                # snapshots under a no-concurrent-writer assumption, which
+                # against a live foreign run is exactly the pollution the
+                # refusal exists to prevent.
+                os.makedirs(directory, exist_ok=True)
+                prior = self._read_tag(directory)
+                if prior is not None and prior != run_id:
+                    self.error = (
+                        f"checkpoint subdirectory {directory!r} is tagged "
+                        f"with run id {prior!r}, not this run's {run_id!r} "
+                        f"— a checkpoint root is exclusive to one run "
+                        f"identity; clear the directory or point "
+                        f"stream.checkpoint.dir elsewhere")
+                else:
+                    with open(os.path.join(directory, "RUN_TAG"), "w") as fh:
+                        fh.write(run_id)
+            if self.error is None:
+                self.mgr = CheckpointManager(directory, keep=2)
+            if resume and self.error is None:
+                state = None
+                try:
+                    state = self.mgr.restore()
+                except Exception as e:
+                    self.error = (f"checkpoint restore from {directory!r} "
+                                  f"failed: {type(e).__name__}: {e}")
+                if state is not None:
+                    # snapshots fingerprint the run identity that wrote
+                    # them (graftlint GL002): a stale snapshot from another
+                    # configuration must fail loudly, never merge silently
+                    snap_run = str(state.get("run", ""))
+                    if snap_run and self.run_id and snap_run != self.run_id:
+                        self.error = (
+                            f"snapshot in {directory!r} was written by run "
+                            f"{snap_run!r}, not this run {self.run_id!r} — "
+                            f"the configuration changed since the "
+                            f"checkpoint; clear the directory and re-run")
+                        state = None
+                if state is not None:
+                    self.accumulator.load(state["acc"])
+                    self.base_rows = int(state["rows"])
+                    self.start = {k: state["cursor"][k]
+                                  for k in ("file", "offset", "chunk")}
+        except Exception as e:
+            # ANY construction failure (tag write, makedirs, manager
+            # recovery, malformed snapshot) must be deferrable: a process
+            # raising here before the handshake would strand its peers in
+            # the collective
+            self.error = (f"checkpointer construction in {directory!r} "
+                          f"failed: {type(e).__name__}: {e}")
+        if self.error and not defer_errors:
+            raise ConfigError(self.error)
 
     @staticmethod
     def run_id_from_conf(conf: JobConfig) -> str:
         """The run's identity tag: ``stream.run.id`` when set, else a
-        fingerprint of the stable properties.  Volatile relaunch flags
-        (``stream.resume``, ``stream.fault.*``) are excluded so a crashed
-        run and its resume relaunch share the identity — the finish()
-        sweep may then reclaim the crashed run's subdirectories at ANY
-        process count, while a different job's live snapshots (different
-        properties → different id) are never touched."""
+        fingerprint of the stable properties.  Volatile relaunch flags and
+        operational knobs (``stream.resume``, ``stream.fault.*``,
+        ``stream.checkpoint.*``, ``stream.prefetch.*``) are excluded so a
+        crashed run and its resume relaunch share the identity even when
+        the relaunch drops the fault-injection/interval knobs — the
+        finish() sweep may then reclaim the crashed run's subdirectories
+        at ANY process count, and the snapshot run-fingerprint gate
+        (round 8) accepts the relaunch, while a different job's live
+        snapshots (different semantic properties → different id) are
+        rejected loudly.  ``stream.chunk.rows`` stays IN the fingerprint:
+        it defines the chunk boundaries a persisted cursor means."""
         explicit = conf.get("stream.run.id")
         if explicit:
             return explicit
         import hashlib
 
+        volatile = ("stream.resume", "stream.fault.", "stream.checkpoint.",
+                    "stream.prefetch.")
         stable = sorted(
             (k, v) for k, v in conf.props.items()
-            if k != "stream.resume" and not k.startswith("stream.fault."))
+            if not any(k == v0.rstrip(".") or k.startswith(v0)
+                       for v0 in volatile))
         return hashlib.blake2s(repr(stable).encode(),
                                digest_size=6).hexdigest()
 
@@ -737,17 +797,52 @@ class StreamCheckpointer:
         # double-counted) instead of resuming a cursor whose ownership
         # pattern no longer matches.
         pid, nprocs = Job.process_grid()
+        if nprocs >= 10 ** 3:
+            # the proc subdirectory name is 3-digit zero-padded; a wider
+            # count would still format (python widens) but break the
+            # fixed-width == lexicographic contract the sweep regex and
+            # any sorted listing rely on (graftlint GL003)
+            raise ConfigError(
+                f"{nprocs} processes exceeds the proc-NNN-of-NNN 3-digit "
+                f"checkpoint-subdirectory width")
         parent = None
         if nprocs > 1:
             parent = directory
             directory = os.path.join(directory,
                                      f"proc-{pid:03d}-of-{nprocs:03d}")
-        return cls(directory,
+        ckpt = cls(directory,
                    conf.get_int("stream.checkpoint.interval.chunks", 8),
                    conf.get_bool("stream.resume", False),
                    conf.get_int("stream.fault.crash.after.chunks", 0),
                    parent_dir=parent,
-                   run_id=cls.run_id_from_conf(conf))
+                   run_id=cls.run_id_from_conf(conf),
+                   defer_errors=nprocs > 1)
+        if nprocs > 1:
+            ckpt._handshake_errors(pid)
+        return ckpt
+
+    def _handshake_errors(self, pid: int) -> None:
+        """Distributed construction/restore handshake (round-8 graftlint
+        GL001 audit): every process enters exactly ONE collective carrying
+        its construction error (or nothing), so a tag conflict or corrupt
+        snapshot on ANY process raises on ALL of them — instead of one
+        process dying early and stranding its peers in the end-of-stream
+        merge.  The same error-through-the-collective pattern as the LR
+        resume broadcast (jobs/regress.py::_broadcast_resume)."""
+        from avenir_tpu.parallel.mesh import all_process_sum_state
+
+        assert pid < 10 ** 3          # from_conf bounds nprocs (GL003)
+        state = {}
+        if self.error:
+            state[f"ckpt_err_p{pid:03d}"] = np.frombuffer(
+                self.error.encode(), np.uint8).copy()
+        folded = all_process_sum_state(state)
+        errs = sorted(k for k in folded if k.startswith("ckpt_err_p"))
+        if errs:
+            peers = ", ".join(k[len("ckpt_err_p"):] for k in errs)
+            raise ConfigError(
+                f"checkpointer construction failed on process(es) {peers}: "
+                + folded[errs[0]].tobytes().decode(errors="replace"))
 
     def chunk_done(self, cursor: dict, last: bool) -> None:
         """Called by the stream after the model has accumulated the chunk
@@ -756,12 +851,15 @@ class StreamCheckpointer:
         self._consumed += 1
         total_rows = self.base_rows + int(cursor["rows"])
         if not last and self._consumed % self.interval == 0:
+            # "run" fingerprints the writing configuration (graftlint
+            # GL002): restore rejects a snapshot whose run id differs
             self.mgr.save(int(cursor["chunk"]),
                           {"acc": self.accumulator.state(),
                            "cursor": {"file": cursor["file"],
                                       "offset": int(cursor["offset"]),
                                       "chunk": int(cursor["chunk"])},
-                           "rows": total_rows})
+                           "rows": total_rows,
+                           "run": self.run_id})
         if self.crash_after and self._consumed >= self.crash_after:
             raise RuntimeError(
                 f"stream.fault.crash.after.chunks={self.crash_after}: "

@@ -200,6 +200,15 @@ class LogisticRegression:
                 raise ValueError(
                     f"chunk design width {x.shape[1]} != global width {d} — "
                     "schema mismatch across chunks/processes")
+        for idx, _x, _y in chunks:
+            if idx >= 10 ** 8:
+                # the gradient keys below are 8-digit zero-padded and the
+                # per-iteration fold sums them in sorted() order — an index
+                # past the width would silently reorder the f64 addition
+                # sequence and break the byte-identity contract (GL003)
+                raise ValueError(
+                    f"chunk index {idx} exceeds the 8-digit gradient-key "
+                    f"width; raise stream.chunk.rows")
         dev = [(idx, jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32))
                for idx, x, y in chunks]
         if resume_from is not None:

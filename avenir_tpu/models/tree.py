@@ -831,8 +831,11 @@ class DecisionTree:
                 [] for _ in range(k)]
             if use_device_sel:
                 # one dispatch (histograms + scores + per-node top-k on
-                # device), one KB-sized fetch
+                # device), one KB-sized fetch — this sync IS the designed
+                # once-per-level descriptor transfer that replaced the
+                # full-table fetch (the r05 RTT wall this rule encodes)
                 top_k = min(max(self.top_n, 1), flat.seg_tab_dev.shape[0])
+                # graftlint: disable=GL005
                 vals, idx, whist = jax.device_get(_device_select_splits(
                     table_dev, flat.seg_tab_dev, flat.attr_dev,
                     flat.nseg_dev, jnp.asarray(flat.allow_vector(attrs_lv)),

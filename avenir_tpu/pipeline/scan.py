@@ -347,6 +347,11 @@ class SharedScan:
                 acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
                 for s in range(0, len(pair_index), self.pair_chunk):
                     sl = pair_index[s:s + self.pair_chunk]
+                    # SharedScan accumulators live only for one fused scan
+                    # (checkpointed stages never fuse — stage_fusable), so
+                    # no restore path exists for a stale key to corrupt;
+                    # keys mirror models/mutual_info.py's gated family
+                    # graftlint: disable=GL002
                     acc.add(f"pcc{s}", agg.pair_class_counts(
                         codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b))
             if needs_moments and not moments_done:

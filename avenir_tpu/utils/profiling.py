@@ -37,8 +37,12 @@ def device_sync(value):
             for sh in shards:
                 d = sh.data
                 if getattr(d, "size", 0):
+                    # this helper IS the blessed sync point the GL005 rule
+                    # steers hot loops toward — one scalar per shard, by
+                    # design            # graftlint: disable=GL005
                     np.asarray(jax.device_get(d.ravel()[0] if d.ndim else d))
         else:
+            # graftlint: disable=GL005 — same: the sync helper itself
             np.asarray(jax.device_get(x.ravel()[0] if x.ndim else x))
     return value
 

@@ -22,6 +22,13 @@ einsum/scatter form it replaced measured ~80-113 M rows/s on the same rig
 and remains the fallback (and the multi-device path).  The remaining wall
 is the W=384 int8 gram's ~30%-of-peak MXU ceiling, cross-validated against
 bare XLA (see ops/pallas_hist.py docstring + benchmarks/*_probe.py).
+
+Round 8: this script (and every benchmarks/ probe) is gated by graftlint
+in tier-1 — ``python -m avenir_tpu.analysis`` / tests/test_analysis.py —
+so a timing loop that regresses into a host-sync-per-iteration pattern
+(GL005: .item()/device_get inside the measured loop — the r05 RTT-wall
+class the honest-sync discipline here exists to avoid) fails CI before it
+can publish an RTT measurement as a kernel number (docs/analysis.md).
 """
 
 import json

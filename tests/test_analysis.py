@@ -1,0 +1,367 @@
+"""graftlint (avenir_tpu/analysis) — fixture snippets per rule (positive
+must fail without the rule, negative must stay clean), the suppression /
+baseline / registry mechanics, the CLI contract, and the live whole-tree
+gate: the entire ``avenir_tpu/`` + ``benchmarks/`` + ``bench.py`` tree must
+carry zero non-baselined findings — graftlint is tier-1 CI from day one.
+
+Pure stdlib + the analysis package: no jax import anywhere here, so the
+lint gate also attests that ``avenir_tpu.analysis`` stays importable
+without a device runtime.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from avenir_tpu.analysis import engine
+from avenir_tpu.analysis import registry_gen
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# every fixture is (rule, should_fire, source) — config_keys passed where
+# GL004 needs a registry
+GL001_POS = """\
+import os
+from avenir_tpu.parallel.mesh import all_process_sum_state
+
+def merge_resume(path):
+    text = open(path).read()          # unguarded divergent read
+    return all_process_sum_state({"h": text})
+"""
+
+GL001_NEG_GUARDED = """\
+import jax
+from avenir_tpu.parallel.mesh import all_process_sum_state
+
+def merge_resume(path):
+    state = {}
+    if jax.process_index() == 0:
+        state["h"] = open(path).read()     # writer-guarded: broadcast via
+    return all_process_sum_state(state)    # the collective itself
+"""
+
+GL001_NEG_NO_SINK = """\
+def local_read(path):
+    return open(path).read()          # no collective in sight
+"""
+
+GL002_POS_SNAPSHOT = """\
+def snapshot(mgr, acc, cur):
+    mgr.save(1, {"acc": acc, "cursor": cur, "rows": 7})
+"""
+
+GL002_NEG_SNAPSHOT = """\
+def snapshot(mgr, acc, cur, rid):
+    mgr.save(1, {"acc": acc, "cursor": cur, "rows": 7, "run": rid})
+"""
+
+GL002_POS_KEY = """\
+def accumulate(acc, chunks):
+    for s, tensor in chunks:
+        acc.add(f"c{s}", tensor)
+"""
+
+GL002_NEG_KEY = """\
+def accumulate(acc, chunks, fingerprint):
+    for s, tensor in chunks:
+        acc.add(f"{fingerprint}:{s}", tensor)
+"""
+
+GL003_POS = """\
+def key_for(idx):
+    return f"g{idx:08d}"
+"""
+
+GL003_NEG = """\
+def key_for(idx):
+    if idx >= 10 ** 8:
+        raise ValueError("index exceeds the 8-digit key width")
+    return f"g{idx:08d}"
+"""
+
+GL004_SRC = """\
+def run(conf):
+    return conf.get_int("some.key", 1)
+"""
+
+GL004_NEG_DICT = """\
+def run(merged):
+    return merged.get("rows", 0)      # plain dict, not a JobConfig
+"""
+
+GL005_POS_FLOAT = """\
+import jax.numpy as jnp
+
+def fold(chunks):
+    tot = 0.0
+    for c in chunks:
+        s = jnp.sum(c)
+        tot += float(s)               # per-chunk host sync
+    return tot
+"""
+
+GL005_POS_ITEM = """\
+def fold(chunks):
+    tot = 0.0
+    for c in chunks:
+        tot += c.sum().item()
+    return tot
+"""
+
+GL005_POS_DEVICE_GET = """\
+import jax
+
+def fold(levels, step):
+    out = []
+    while levels:
+        out.append(jax.device_get(step(levels.pop())))
+    return out
+"""
+
+GL005_NEG_OUTSIDE = """\
+import jax.numpy as jnp
+
+def fold(chunks):
+    s = jnp.sum(jnp.stack(list(chunks)))
+    return float(s)                   # one sync after the loop-free reduce
+"""
+
+GL005_NEG_ON_HOST = """\
+import jax.numpy as jnp
+from avenir_tpu.ops.info import on_host
+
+def fold(chunks):
+    out = []
+    with on_host():
+        for c in chunks:
+            s = jnp.sum(c)
+            out.append(float(s))      # explicit host-compute escape hatch
+    return out
+"""
+
+
+def lint_src(tmp_path, src, config_keys=None, name="snippet.py",
+             baseline_path=None):
+    f = tmp_path / name
+    f.write_text(src)
+    return engine.run_paths([str(f)], root=str(tmp_path),
+                            baseline_path=baseline_path,
+                            config_keys=config_keys)
+
+
+FIXTURES = [
+    ("GL001", True, GL001_POS),
+    ("GL001", False, GL001_NEG_GUARDED),
+    ("GL001", False, GL001_NEG_NO_SINK),
+    ("GL002", True, GL002_POS_SNAPSHOT),
+    ("GL002", False, GL002_NEG_SNAPSHOT),
+    ("GL002", True, GL002_POS_KEY),
+    ("GL002", False, GL002_NEG_KEY),
+    ("GL003", True, GL003_POS),
+    ("GL003", False, GL003_NEG),
+    ("GL005", True, GL005_POS_FLOAT),
+    ("GL005", True, GL005_POS_ITEM),
+    ("GL005", True, GL005_POS_DEVICE_GET),
+    ("GL005", False, GL005_NEG_OUTSIDE),
+    ("GL005", False, GL005_NEG_ON_HOST),
+]
+
+
+@pytest.mark.parametrize("rule,fires,src", FIXTURES,
+                         ids=[f"{r}-{'pos' if p else 'neg'}-{i}"
+                              for i, (r, p, _) in enumerate(FIXTURES)])
+def test_rule_fixture(tmp_path, rule, fires, src):
+    found = [f for f in lint_src(tmp_path, src, config_keys={})
+             if f.rule == rule]
+    if fires:
+        assert found, f"{rule} should fire on:\n{src}"
+    else:
+        assert not found, (f"{rule} must stay quiet on:\n{src}\n"
+                           + "\n".join(f.format() for f in found))
+
+
+def test_gl004_unknown_undocumented_and_known(tmp_path):
+    unknown = lint_src(tmp_path, GL004_SRC, config_keys={})
+    assert [f.rule for f in unknown] == ["GL004"]
+    assert "unknown config key 'some.key'" in unknown[0].message
+
+    undoc = lint_src(tmp_path, GL004_SRC, config_keys={"some.key": None})
+    assert [f.rule for f in undoc] == ["GL004"]
+    assert "undocumented" in undoc[0].message
+
+    ok = lint_src(tmp_path, GL004_SRC,
+                  config_keys={"some.key": "docs/jobs.md"})
+    assert not ok
+
+    assert not lint_src(tmp_path, GL004_NEG_DICT, config_keys={})
+
+
+def test_gl004_registry_matches_tree():
+    """The checked-in registry is exactly what a regeneration produces —
+    i.e. nobody added a conf key without regenerating (the GL004 contract
+    that code and registry can never drift apart silently)."""
+    from avenir_tpu.analysis.config_registry import CONFIG_KEYS
+
+    code = registry_gen.scan_code_keys(
+        [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
+         str(REPO / "bench.py")])
+    assert sorted(code) == sorted(CONFIG_KEYS), (
+        "config_registry.py is stale — run "
+        "`python -m avenir_tpu.analysis --write-registry`")
+    undocumented = sorted(k for k, v in CONFIG_KEYS.items() if v is None)
+    assert not undocumented, (
+        f"undocumented config keys: {undocumented} — add them to "
+        f"docs/jobs.md and regenerate the registry")
+
+
+def test_registry_generator_roundtrip(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        "def run(conf):\n"
+        "    return conf.get('a.b'), conf.get_bool('c.d')\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "ref.md").write_text(
+        "Keys: `a.b` (a thing), and fenced blocks must not desync:\n"
+        "```\nconf `not.this` stuff\n```\n`-Dc.d=true` works too.\n")
+    out = tmp_path / "registry.py"
+    registry = registry_gen.write_registry(
+        [str(tmp_path / "mod.py")], [str(docs)], root=str(tmp_path),
+        out_path=str(out))
+    assert registry == {"a.b": "docs/ref.md", "c.d": "docs/ref.md"}
+    ns: dict = {}
+    exec(out.read_text(), ns)                 # the generated file is valid
+    assert ns["CONFIG_KEYS"] == registry
+
+
+# -- suppression / baseline mechanics ------------------------------------
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    inline = GL005_POS_ITEM.replace(
+        "tot += c.sum().item()",
+        "tot += c.sum().item()  # graftlint: disable=GL005")
+    assert not lint_src(tmp_path, inline, config_keys={})
+
+    above = GL005_POS_ITEM.replace(
+        "        tot += c.sum().item()",
+        "        # graftlint: disable=GL005\n"
+        "        tot += c.sum().item()")
+    assert not lint_src(tmp_path, above, config_keys={})
+
+    # suppressing a DIFFERENT rule must not hide the finding
+    wrong = GL005_POS_ITEM.replace(
+        "tot += c.sum().item()",
+        "tot += c.sum().item()  # graftlint: disable=GL003")
+    assert [f.rule for f in lint_src(tmp_path, wrong, config_keys={})] \
+        == ["GL005"]
+
+
+def test_suppression_file_wide(tmp_path):
+    src = "# graftlint: disable-file=GL003\n" + GL003_POS
+    assert not lint_src(tmp_path, src, config_keys={})
+
+
+def test_baseline_pass_and_new_finding_fails(tmp_path):
+    """The three-way contract: suppressed line → pass, baselined legacy
+    finding → pass, NEW finding → fail."""
+    live = lint_src(tmp_path, GL003_POS, config_keys={},
+                    name="legacy.py")
+    assert len(live) == 1 and not live[0].baselined
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [
+        {"rule": live[0].rule, "path": live[0].path,
+         "message": live[0].message, "why": "grandfathered for the test"}
+    ]}))
+    again = lint_src(tmp_path, GL003_POS, config_keys={},
+                     name="legacy.py", baseline_path=str(bl))
+    assert len(again) == 1 and again[0].baselined
+
+    fresh = lint_src(tmp_path, GL003_POS, config_keys={},
+                     name="fresh.py", baseline_path=str(bl))
+    assert len(fresh) == 1 and not fresh[0].baselined
+
+
+def test_write_baseline_preserves_existing_whys(tmp_path):
+    """--write-baseline must merge: entries still matching a finding keep
+    their curated why; only genuinely new findings get stubs (code-review
+    finding — a rewrite used to drop every grandfathered entry)."""
+    (tmp_path / "legacy.py").write_text(GL003_POS)
+    (tmp_path / "fresh.py").write_text(GL003_POS)
+    bl = tmp_path / "baseline.json"
+    legacy = lint_src(tmp_path, GL003_POS, config_keys={},
+                      name="legacy.py")[0]
+    bl.write_text(json.dumps({"findings": [
+        {"rule": legacy.rule, "path": legacy.path,
+         "message": legacy.message, "why": "curated reason"}]}))
+    findings = engine.run_paths(
+        [str(tmp_path / "legacy.py"), str(tmp_path / "fresh.py")],
+        root=str(tmp_path), baseline_path=str(bl), config_keys={})
+    engine.write_baseline(str(bl), findings,
+                          existing=engine.load_baseline(str(bl)))
+    merged = json.loads(bl.read_text())["findings"]
+    whys = {e["path"]: e["why"] for e in merged}
+    assert whys["legacy.py"] == "curated reason"
+    assert "FILL ME IN" in whys["fresh.py"]
+
+
+def test_baseline_requires_why(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"findings": [
+        {"rule": "GL003", "path": "x.py", "message": "m", "why": ""}]}))
+    with pytest.raises(ValueError, match="why"):
+        engine.load_baseline(str(bl))
+
+
+def test_syntax_error_reports_gl000(tmp_path):
+    findings = lint_src(tmp_path, "def broken(:\n", config_keys={})
+    assert [f.rule for f in findings] == ["GL000"]
+
+
+# -- CLI contract ---------------------------------------------------------
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "avenir_tpu.analysis", *args],
+        cwd=cwd, capture_output=True, text=True,
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"})
+
+
+def test_cli_findings_format_and_exit_code(tmp_path):
+    (tmp_path / "bad.py").write_text(GL003_POS)
+    res = _run_cli(["bad.py", "--no-baseline"], cwd=str(tmp_path))
+    assert res.returncode == 1
+    assert res.stdout.startswith("bad.py:2: GL003 ")
+    assert "graftlint: 1 finding(s)" in res.stderr
+
+    res_json = _run_cli(["bad.py", "--no-baseline", "--json"],
+                        cwd=str(tmp_path))
+    payload = json.loads(res_json.stdout)
+    assert payload[0]["rule"] == "GL003" and payload[0]["path"] == "bad.py"
+
+
+def test_cli_clean_exits_zero(tmp_path):
+    (tmp_path / "ok.py").write_text(GL003_NEG)
+    res = _run_cli(["ok.py"], cwd=str(tmp_path))
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# -- the live gate: the whole tree, as CI ---------------------------------
+
+def test_whole_tree_zero_nonbaselined_findings():
+    findings = engine.run_paths(
+        [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
+         str(REPO / "bench.py")], root=str(REPO))
+    live = [f for f in findings if not f.baselined]
+    assert not live, (
+        "graftlint found new hazards (fix them, suppress with a "
+        "why-comment, or — for legacy findings only — baseline them):\n"
+        + "\n".join(f.format() for f in live))
+    # the baseline must stay honest too: every entry still matches a real
+    # finding (a fixed finding must leave the baseline when it's fixed)
+    matched = {f.key for f in findings if f.baselined}
+    stale = [e for e in engine.load_baseline(engine.BASELINE_PATH)
+             if (e["rule"], e["path"], e["message"]) not in matched]
+    assert not stale, f"baseline entries no longer match any finding: {stale}"

@@ -1,9 +1,14 @@
-"""Import-smoke for every ``benchmarks/*.py`` module.
+"""Import-smoke + lint gate for every ``benchmarks/*.py`` module.
 
 The probes only run by hand on the dev rig, so they rot silently when a
 library symbol they import moves (round-7 CI satellite): importing each
 module compiles it and resolves its module-scope imports without running
-any measurement (they all gate work behind ``__main__``/``main()``)."""
+any measurement (they all gate work behind ``__main__``/``main()``).
+
+Round 8 adds graftlint over the same modules (plus ``bench.py``): probe
+scripts are exactly where host-sync-per-iteration timing bugs (GL005 —
+the r05 RTT-wall class the honest-sync discipline exists for) sneak back
+in, so the hazard rules gate them like library code."""
 
 import importlib
 import pathlib
@@ -22,3 +27,16 @@ def test_benchmarks_exist():
 @pytest.mark.parametrize("mod", _MODULES)
 def test_benchmark_module_imports(mod):
     importlib.import_module(f"benchmarks.{mod}")
+
+
+def test_benchmarks_lint_clean():
+    from avenir_tpu.analysis import engine
+
+    repo = _BENCH_DIR.parent
+    findings = engine.run_paths([str(_BENCH_DIR), str(repo / "bench.py")],
+                                root=str(repo))
+    live = [f for f in findings if not f.baselined]
+    assert not live, (
+        "graftlint hazards in the benchmark probes (a timing loop that "
+        "syncs per iteration measures the RTT, not the kernel):\n"
+        + "\n".join(f.format() for f in live))

@@ -158,6 +158,80 @@ def test_checkpointer_interval_and_crash(tmp_path):
     np.testing.assert_array_equal(ck2.accumulator.get("x"), np.arange(3))
 
 
+def test_snapshot_run_fingerprint_rejected_on_mismatch(tmp_path):
+    """Round-8 graftlint GL002 hardening: snapshots record the run id that
+    wrote them, and a resume under a DIFFERENT run identity (the
+    configuration changed since the checkpoint) must fail loudly instead
+    of silently folding stale partials into the new run's totals."""
+    from avenir_tpu.core.config import ConfigError
+
+    ck = StreamCheckpointer(str(tmp_path / "ck"), interval_chunks=1,
+                            run_id="runA")
+    ck.accumulator.add("x", np.arange(3))
+    ck.chunk_done({"file": "f", "offset": 10, "chunk": 1, "rows": 5},
+                  last=False)
+    # same identity resumes fine
+    ok = StreamCheckpointer(str(tmp_path / "ck"), resume=True,
+                            run_id="runA")
+    assert ok.base_rows == 5
+    with pytest.raises(ConfigError, match="written by run 'runA'"):
+        StreamCheckpointer(str(tmp_path / "ck"), resume=True,
+                           run_id="runB")
+    # deferred mode (multi-process construction) stores instead of raising,
+    # so the error can travel through the cross-process handshake; the
+    # handshake itself re-raises it (trivially so single-process)
+    deferred = StreamCheckpointer(str(tmp_path / "ck"), resume=True,
+                                  run_id="runB", defer_errors=True)
+    assert deferred.error and "written by run 'runA'" in deferred.error
+    with pytest.raises(ConfigError, match="process\\(es\\) 000"):
+        deferred._handshake_errors(0)
+
+
+def test_run_tag_conflict_refused(tmp_path):
+    """A proc subdirectory already tagged by another run id must be
+    refused — overwriting the tag (the pre-round-8 behavior) would let
+    this run's finish() sweep a concurrent job's live snapshots."""
+    from avenir_tpu.core.config import ConfigError
+
+    root = tmp_path / "shared"
+    sub = str(root / "proc-000-of-002")
+    ckA = StreamCheckpointer(sub, parent_dir=str(root), run_id="jobA",
+                             interval_chunks=1)
+    ckA.accumulator.add("x", np.arange(2))
+    ckA.chunk_done({"file": "f", "offset": 9, "chunk": 1, "rows": 3},
+                   last=False)
+    # plant run A's in-flight save temp — the refusal must fire BEFORE
+    # CheckpointManager._recover() can sweep it (code-review finding)
+    inflight = os.path.join(sub, ".ckpt_inflight")
+    os.makedirs(inflight)
+    with pytest.raises(ConfigError, match="exclusive to one run identity"):
+        StreamCheckpointer(sub, parent_dir=str(root), run_id="jobB")
+    # the foreign run's tag, snapshot, AND in-flight temp all survive
+    assert StreamCheckpointer._read_tag(sub) == "jobA"
+    assert os.path.isdir(os.path.join(sub, "step_1"))
+    assert os.path.isdir(inflight)
+    os.rmdir(inflight)
+    # and the same identity re-enters cleanly (crash + relaunch)
+    ok = StreamCheckpointer(sub, parent_dir=str(root), run_id="jobA",
+                            resume=True)
+    assert ok.base_rows == 3
+
+
+def test_construction_failure_deferrable(tmp_path):
+    """ANY construction failure — not just tag/restore ones — must be
+    capturable for the cross-process handshake instead of raising before
+    peers reach their collective (code-review finding): a file squatting
+    on the checkpoint path makes CheckpointManager's makedirs explode."""
+    from avenir_tpu.core.config import ConfigError
+
+    squatter = tmp_path / "ck"
+    squatter.write_text("not a directory")
+    deferred = StreamCheckpointer(str(squatter), defer_errors=True)
+    assert deferred.error and "construction" in deferred.error
+    with pytest.raises(ConfigError, match="construction"):
+        StreamCheckpointer(str(squatter))
+
+
 def test_mi_resume_rejects_incompatible_g_layout():
     """A snapshot holding a G matrix under a different kernel layout key
     (e.g. the round-3 un-qualified "g") must be rejected loudly, never
