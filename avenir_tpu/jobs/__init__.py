@@ -43,6 +43,7 @@ from avenir_tpu.jobs.tree import (
     DecisionTreeBuilder,
     SplitGenerator,
 )
+from avenir_tpu.serving.replay import ScoringPlane
 
 # reference package of each job's counterpart (for fully-qualified lookup)
 _PACKAGES: Dict[str, str] = {
@@ -87,6 +88,9 @@ JOB_CLASSES = [
     GreedyRandomBandit, AuerDeterministic, SoftMaxBandit, RandomFirstGreedyBandit,
     WordCounter,
     RunningAggregator, Projection, NumericalAttrStats,
+    # the serving plane's replay stage (no reference analog: the reference
+    # has no online scoring surface at all — SURVEY §2)
+    ScoringPlane,
 ]
 
 REGISTRY: Dict[str, Type[Job]] = {}

@@ -73,6 +73,30 @@ def _chunk_grad(w: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
     return x.T @ (y - p)
 
 
+@jax.jit
+def _sigmoid_scores(w: jax.Array, x: jax.Array) -> jax.Array:
+    """[N] σ(x·w) — the scoring matvec, jitted so the serving plane runs it
+    from device-resident weights against its fixed bucket shapes."""
+    return jax.nn.sigmoid(x @ w)
+
+
+def predict_batch(model_or_weights, x: np.ndarray,
+                  threshold: float = 0.5) -> Tuple[np.ndarray, np.ndarray]:
+    """([N] f32 probabilities, [N] int32 0/1 labels) from the jitted device
+    scorer — the thin online-scoring entry the batch trainer never needed
+    (the reference scores LR offline through generic chombo tooling; the
+    serving plane is this port's first LR scoring surface).  Per-row dot
+    products make the result independent of the batch padding the serving
+    microbatcher applies.  Accepts a :class:`LogisticRegressionModel` or a
+    raw weight vector (pass a pre-uploaded ``jax.Array`` to keep the
+    weights device-resident across calls)."""
+    w = getattr(model_or_weights, "weights", model_or_weights)
+    if not isinstance(w, jax.Array):
+        w = jnp.asarray(np.asarray(w), jnp.float32)
+    probs = np.asarray(_sigmoid_scores(w, jnp.asarray(np.asarray(x, np.float32))))
+    return probs, (probs >= threshold).astype(np.int32)
+
+
 def _converged(prev: np.ndarray, cur: np.ndarray, criterion: str,
                threshold_pct: float) -> bool:
     """Relative per-coefficient change in percent (LogisticRegressor.java:105-163):
@@ -243,6 +267,11 @@ class LogisticRegression:
     def predict_proba(model: LogisticRegressionModel, x: np.ndarray) -> np.ndarray:
         z = x @ model.weights
         return 1.0 / (1.0 + np.exp(-z))
+
+    @staticmethod
+    def predict_batch(model_or_weights, x: np.ndarray,
+                      threshold: float = 0.5) -> Tuple[np.ndarray, np.ndarray]:
+        return predict_batch(model_or_weights, x, threshold=threshold)
 
     @staticmethod
     def predict(model: LogisticRegressionModel, x: np.ndarray,

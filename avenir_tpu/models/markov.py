@@ -641,8 +641,20 @@ class ViterbiDecoder:
         return np.asarray(fn(self._log_a, self._log_b, self._log_pi,
                              obs_b))[:n]
 
-    def decode(self, obs_seqs: Sequence[Sequence[str]]) -> List[List[str]]:
+    def decode(self, obs_seqs: Sequence[Sequence[str]],
+               pad_to: Optional[int] = None) -> List[List[str]]:
+        """``pad_to`` pins the time axis to a fixed length instead of the
+        batch max — the serving plane's shape discipline (one compiled
+        program per bucket, regardless of the sequences in it).  Padded
+        steps are max-plus identities, so the decoded path of each record
+        is identical for any ``pad_to`` ≥ its length; longer sequences
+        raise (a serving request must fail loudly, not silently truncate)."""
         t = max((len(s) for s in obs_seqs), default=0)
+        if pad_to is not None:
+            if t > pad_to:
+                raise ValueError(
+                    f"sequence of length {t} exceeds pad_to={pad_to}")
+            t = pad_to
         codes = np.full((len(obs_seqs), t), -1, np.int32)
         for r, seq in enumerate(obs_seqs):
             for j, o in enumerate(seq):
@@ -661,10 +673,11 @@ class ViterbiStatePredictor:
         self.pair_output = pair_output
         self.delim = delim
 
-    def predict_lines(self, rows: Sequence[Sequence[str]]) -> List[str]:
+    def predict_lines(self, rows: Sequence[Sequence[str]],
+                      pad_to: Optional[int] = None) -> List[str]:
         ids = [r[0] for r in rows]
         seqs = [list(r[1:]) for r in rows]
-        paths = self.decoder.decode(seqs)
+        paths = self.decoder.decode(seqs, pad_to=pad_to)
         out = []
         for rid, seq, path in zip(ids, seqs, paths):
             if self.pair_output:

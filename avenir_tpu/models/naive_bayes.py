@@ -90,18 +90,23 @@ class NaiveBayesModel:
         return len(self.class_values)
 
     def scoring_params(self):
-        """Device-ready arrays for the jitted scorer."""
-        mean_std = self.cont_stats
-        if mean_std is None:
-            mean = std = np.zeros((self.num_classes, 0), np.float32)
-        else:
-            mean, std = mean_std
-        return (
-            jnp.asarray(self.log_posterior, jnp.float32),
-            jnp.asarray(self.log_prior, jnp.float32),
-            jnp.asarray(mean, jnp.float32),
-            jnp.asarray(std, jnp.float32),
-        )
+        """Device-ready arrays for the jitted scorer, cached on the model —
+        repeated scoring calls (the serving plane's steady state) must not
+        re-upload the tables per batch."""
+        cached = self.__dict__.get("_scoring_params")
+        if cached is None:
+            mean_std = self.cont_stats
+            if mean_std is None:
+                mean = std = np.zeros((self.num_classes, 0), np.float32)
+            else:
+                mean, std = mean_std
+            cached = self.__dict__["_scoring_params"] = (
+                jnp.asarray(self.log_posterior, jnp.float32),
+                jnp.asarray(self.log_prior, jnp.float32),
+                jnp.asarray(mean, jnp.float32),
+                jnp.asarray(std, jnp.float32),
+            )
+        return cached
 
 
 def model_from_counts(
@@ -162,6 +167,23 @@ def nb_log_scores(
         logpdf = -0.5 * (((x - mu) / sd) ** 2) - jnp.log(sd) - 0.5 * _LOG2PI
         scores = scores + jnp.sum(logpdf, axis=2)
     return scores
+
+
+def predict_batch(model: NaiveBayesModel, codes: np.ndarray,
+                  cont: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """([N, C] log scores, [N, C] normalized posteriors) — the ONE scoring
+    entry both the batch predictor (:meth:`NaiveBayes.predict`) and the
+    serving plane route through, so their numerics agree by construction.
+    Device tables come from the model's cached :meth:`scoring_params`
+    (uploaded once); the jitted gather compiles per batch shape, which the
+    serving microbatcher pins to its fixed bucket sizes."""
+    params = model.scoring_params()
+    scores = np.asarray(nb_log_scores(*params, jnp.asarray(codes),
+                                      jnp.asarray(cont)))
+    shifted = scores - scores.max(axis=1, keepdims=True)
+    expd = np.exp(shifted)
+    probs = expd / expd.sum(axis=1, keepdims=True)
+    return scores, probs
 
 
 @dataclass
@@ -244,11 +266,7 @@ class NaiveBayes:
         validate: bool = False,
         pos_class: Optional[str] = None,
     ) -> PredictionResult:
-        params = model.scoring_params()
-        scores = np.asarray(nb_log_scores(*params, jnp.asarray(ds.codes), jnp.asarray(ds.cont)))
-        shifted = scores - scores.max(axis=1, keepdims=True)
-        expd = np.exp(shifted)
-        probs = expd / expd.sum(axis=1, keepdims=True)
+        scores, probs = predict_batch(model, ds.codes, ds.cont)
         if cost is not None:
             predicted = CostBasedArbitrator(model.class_values, cost).arbitrate(probs)
         else:

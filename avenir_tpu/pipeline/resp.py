@@ -24,10 +24,14 @@ class RespClient:
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  db: int = 0, timeout: float = 5.0):
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._db = db
+        self.reconnects = 0              # transport faults absorbed so far
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._buf = b""
         if db:
-            self.command("SELECT", db)
+            self._exchange(("SELECT", db))
 
     def close(self) -> None:
         try:
@@ -35,16 +39,46 @@ class RespClient:
         except OSError:
             pass
 
+    def _reconnect(self) -> None:
+        self.close()
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        self._buf = b""
+        self.reconnects += 1
+        if self._db:
+            self._exchange(("SELECT", self._db))
+
     # -- protocol ------------------------------------------------------------
-    def command(self, *args: Union[str, bytes, int, float]):
-        """Send one command as a RESP array of bulk strings; return the
-        decoded reply (str | int | None | list, recursively)."""
+    def _exchange(self, args):
         parts = [b"*%d\r\n" % len(args)]
         for a in args:
             b = a if isinstance(a, bytes) else str(a).encode()
             parts.append(b"$%d\r\n%s\r\n" % (len(b), b))
         self._sock.sendall(b"".join(parts))
         return self._read_reply()
+
+    def command(self, *args: Union[str, bytes, int, float],
+                retry: bool = True):
+        """Send one command as a RESP array of bulk strings; return the
+        decoded reply (str | int | None | list, recursively).
+
+        Survives ONE transient transport fault per call (server restart,
+        idle-connection reap): any ``ConnectionError`` — ``BrokenPipeError``
+        / ``ConnectionResetError`` on send, or the clean-close error the
+        reply reader raises — triggers a reconnect and a single resend.
+        Caveat the caller owns: if the fault hit AFTER the server executed
+        the command (reply lost in flight), the resend makes delivery
+        at-least-once — the same trade Jedis' reconnect-on-retry makes.
+        Pass ``retry=False`` for writes where a duplicate is worse than a
+        surfaced fault (e.g. non-idempotent LPUSH into an exactly-once
+        pipeline)."""
+        try:
+            return self._exchange(args)
+        except ConnectionError:
+            if not retry:
+                raise
+            self._reconnect()
+            return self._exchange(args)
 
     def _read_line(self) -> bytes:
         while b"\r\n" not in self._buf:

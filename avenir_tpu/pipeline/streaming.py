@@ -21,10 +21,12 @@ between events (the reference loses bolt state on restart, SURVEY.md §3.5).
 from __future__ import annotations
 
 import json
+import time
 from collections import deque
 from typing import Callable, Iterable, List, Optional, Protocol, Tuple
 
 from avenir_tpu.models.online_rl import ReinforcementLearner
+from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
 
 
 # ---------------------------------------------------------------------------
@@ -147,7 +149,18 @@ class RedisActionWriter(QueueActionWriter):
 
 class ReinforcementLearnerServer:
     """Per event: drain rewards → update learner → emit next actions
-    (ReinforcementLearnerBolt.java:93-125)."""
+    (ReinforcementLearnerBolt.java:93-125).
+
+    Observability rides the SAME schema as the scoring plane
+    (``serving/batcher.py``): a ``Serving.<model_name>`` counter group plus
+    a :class:`LatencyTracker`, published through :meth:`stats` — so the two
+    online paths (RL loop, ServeGraft) report through one shape and
+    BASELINE.md's serving rows compare like for like.  The RL loop
+    dispatches one event at a time, so its whole size histogram lands in
+    ``bucket.1``.  Pass shared ``counters``/``latency`` objects to
+    aggregate several servers (e.g. a fleet's per-group learners) into one
+    report.
+    """
 
     def __init__(
         self,
@@ -157,6 +170,9 @@ class ReinforcementLearnerServer:
         actions: ActionWriter,
         log_interval: int = 0,
         on_log: Optional[Callable[[int], None]] = None,
+        counters: Optional[Counters] = None,
+        latency: Optional[LatencyTracker] = None,
+        model_name: str = "rl",
     ):
         self.learner = learner
         self.events = events
@@ -165,17 +181,30 @@ class ReinforcementLearnerServer:
         self.log_interval = log_interval
         self.on_log = on_log
         self.processed = 0
+        self.model_name = model_name
+        self.counters = counters if counters is not None else Counters()
+        self.latency = latency if latency is not None else LatencyTracker()
 
     def handle(self, event_id: str, round_num: int) -> None:
         """The per-event body (drain rewards → update → emit actions) —
         shared by :meth:`process_one` and the ShardedServingFleet workers."""
+        t0 = time.monotonic()
         for action, reward in self.rewards.read_rewards():
             self.learner.set_reward(action, reward)
         selected = self.learner.next_actions(round_num)
         self.actions.write(event_id, selected)
         self.processed += 1
+        self.latency.record(time.monotonic() - t0)
+        group = f"Serving.{self.model_name}"
+        self.counters.increment(group, "requests")
+        self.counters.increment(group, "batches")
+        self.counters.increment(group, "bucket.1")
         if self.log_interval and self.on_log and self.processed % self.log_interval == 0:
             self.on_log(self.processed)
+
+    def stats(self) -> dict:
+        """The scoring plane's stats schema (utils/metrics.serving_stats)."""
+        return serving_stats(self.counters, {self.model_name: self.latency})
 
     def process_one(self) -> bool:
         """Handle one event; False when the event queue is empty."""

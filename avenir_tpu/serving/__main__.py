@@ -1,0 +1,80 @@
+"""Serving CLI — ``python -m avenir_tpu.serving --conf serve.properties``.
+
+Loads every family in ``serve.models`` from the properties file's artifact
+paths, warms the (model, bucket) compile cache, and serves:
+
+- HTTP on ``serve.http.port`` (default 8390): ``POST /score``,
+  ``GET /healthz``, ``GET /stats`` — see docs/deployment.md for a
+  serve-then-curl walkthrough;
+- optionally a RESP list pair on a Redis server when
+  ``serve.request.queue`` is set (``serve.redis.host``/``serve.redis.port``,
+  responses to ``serve.response.queue``) — the transport the reference's
+  own Redis simulators drive.
+
+Runs until interrupted; stats print once on shutdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from typing import List
+
+from avenir_tpu.core.config import JobConfig
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m avenir_tpu.serving",
+        description="ServeGraft — device-resident online scoring plane")
+    ap.add_argument("--conf", required=True,
+                    help="properties file (serve.* keys + model artifacts)")
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="override serve.http.port")
+    args = ap.parse_args(argv)
+
+    from avenir_tpu.serving.batcher import BucketedMicrobatcher
+    from avenir_tpu.serving.frontend import (
+        ScoreHTTPServer,
+        redis_score_frontend,
+    )
+    from avenir_tpu.serving.registry import ModelRegistry
+
+    conf = JobConfig.from_file(args.conf)
+    registry = ModelRegistry.from_conf(conf)
+    batcher = BucketedMicrobatcher.from_conf(registry, conf)
+    port = (args.http_port if args.http_port is not None
+            else conf.get_int("serve.http.port", 8390))
+    http = ScoreHTTPServer(batcher, port=port).start()
+    print(f"serving {registry.names()} on "
+          f"http://{http.address[0]}:{http.address[1]} "
+          f"(buckets {batcher.buckets})", flush=True)
+
+    request_queue = conf.get("serve.request.queue")
+    if request_queue:
+        frontend = redis_score_frontend(
+            batcher,
+            host=conf.get("serve.redis.host", "localhost"),
+            port=conf.get_int("serve.redis.port", 6379),
+            request_queue=request_queue,
+            response_queue=conf.get("serve.response.queue",
+                                    "scoreResponseQueue"))
+        threading.Thread(target=frontend.run, daemon=True,
+                         name="serve-resp").start()
+        print(f"RESP transport polling {request_queue!r}", flush=True)
+
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        http.stop()
+        batcher.close()
+        print(json.dumps(batcher.stats()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,285 @@
+"""Bucketed microbatcher — the scoring plane's shape-discipline core.
+
+Concurrent requests for one model are folded into padded power-of-two batch
+buckets (``serve.bucket.sizes``), every (model, bucket) shape is compiled at
+startup (``serve.warmup.on.start``), and steady-state serving therefore
+NEVER recompiles — the compiler-first caching discipline of
+"Compiler-First State Space Duality and Portable O(1) Autoregressive
+Caching for Inference" (PAPERS.md) applied to this framework's classical
+models.  The batcher diffs each entry's ``compile_keys`` after every batch
+and publishes a ``recompiles`` counter so the invariant is *measured*, not
+assumed (benchmarks/serving_qps.py asserts it is zero).
+
+Latency/throughput policy:
+
+- a batch dispatches as soon as a full ``max(bucket)`` is waiting, or when
+  the OLDEST pending request ages past ``serve.flush.deadline.ms`` — the
+  max-latency flush that keeps a lone request from waiting for company;
+- each model's pending queue is bounded by ``serve.queue.depth``; a submit
+  against a full queue is rejected with a typed :class:`ShedError` (the
+  ``max.spout.pending`` analog — load is shed at the door, not absorbed
+  until everything is slow);
+- a request that ages past ``serve.request.timeout.ms`` before a batch
+  picks it up fails with :class:`RequestTimeout`.
+
+One dispatcher thread owns every device call: the accelerator serializes
+batches anyway, and a single submitter keeps the jit cache and the CUDA/TPU
+stream free of cross-thread interleaving.  ``submit`` may be called from any
+number of frontend threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from avenir_tpu.core.config import ConfigError, JobConfig
+from avenir_tpu.serving.errors import (
+    RequestError,
+    RequestTimeout,
+    ServingError,
+    ShedError,
+)
+from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
+
+
+class PendingRequest:
+    """One in-flight request; ``wait`` blocks until scored (or failed)."""
+
+    __slots__ = ("model", "line", "enqueued", "result", "error", "_done")
+
+    def __init__(self, model: str, line: str):
+        self.model = model
+        self.line = line
+        self.enqueued = time.monotonic()
+        self.result: Optional[str] = None
+        self.error: Optional[ServingError] = None
+        self._done = threading.Event()
+
+    def finish(self, result: Optional[str] = None,
+               error: Optional[ServingError] = None) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout_s: Optional[float] = None) -> str:
+        if not self._done.wait(timeout_s):
+            raise RequestTimeout(
+                f"no response for {self.model!r} request within "
+                f"{timeout_s}s (dispatcher wedged or closed?)")
+        if self.error is not None:
+            raise self.error
+        return self.result  # type: ignore[return-value]
+
+
+class BucketedMicrobatcher:
+    def __init__(self, registry: ModelRegistry,
+                 bucket_sizes: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+                 flush_deadline_ms: float = 5.0,
+                 queue_depth: int = 1024,
+                 request_timeout_ms: float = 1000.0,
+                 warmup: bool = True,
+                 counters: Optional[Counters] = None):
+        self.registry = registry
+        self.buckets = sorted({int(b) for b in bucket_sizes})
+        if not self.buckets or self.buckets[0] < 1:
+            raise ConfigError(f"invalid serve.bucket.sizes {bucket_sizes!r}")
+        self.max_bucket = self.buckets[-1]
+        self.flush_deadline_s = float(flush_deadline_ms) / 1e3
+        self.queue_depth = max(int(queue_depth), 1)
+        self.request_timeout_s = float(request_timeout_ms) / 1e3
+        self.counters = counters if counters is not None else Counters()
+        self.latency: Dict[str, LatencyTracker] = {
+            name: LatencyTracker() for name in registry.names()}
+        self._queues: Dict[str, Deque[PendingRequest]] = {
+            name: deque() for name in registry.names()}
+        self._known_keys: Dict[str, set] = {name: set()
+                                            for name in registry.names()}
+        self._cond = threading.Condition()
+        self._stop = False
+        if warmup:
+            self.warm()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-dispatch")
+        self._thread.start()
+
+    @classmethod
+    def from_conf(cls, registry: ModelRegistry,
+                  conf: JobConfig) -> "BucketedMicrobatcher":
+        return cls(
+            registry,
+            bucket_sizes=conf.get_int_list("serve.bucket.sizes",
+                                           [1, 2, 4, 8, 16, 32, 64]),
+            flush_deadline_ms=conf.get_float("serve.flush.deadline.ms", 5.0),
+            queue_depth=conf.get_int("serve.queue.depth", 1024),
+            request_timeout_ms=conf.get_float("serve.request.timeout.ms",
+                                              1000.0),
+            warmup=conf.get_bool("serve.warmup.on.start", True),
+        )
+
+    # -- warmup / recompile accounting ---------------------------------------
+    def warm(self) -> Dict[str, int]:
+        """Compile every (model, bucket) shape; shapes seen here never count
+        as recompiles later."""
+        warmed = self.registry.warmup(self.buckets)
+        for name, entry in self.registry.items():
+            self._known_keys[name] |= set(entry.compile_keys)
+        return warmed
+
+    # -- submission (any thread) ---------------------------------------------
+    def submit_nowait(self, model: str, line: str) -> PendingRequest:
+        entry = self.registry.get(model)            # raises UnknownModelError
+        del entry
+        req = PendingRequest(model, line)
+        with self._cond:
+            if self._stop:
+                raise ServingError("batcher is closed")
+            queue = self._queues[model]
+            if len(queue) >= self.queue_depth:
+                self.counters.increment(f"Serving.{model}", "shed")
+                raise ShedError(
+                    f"{model!r} queue at depth {self.queue_depth} — "
+                    f"request shed (backpressure)")
+            queue.append(req)
+            self._cond.notify()
+        return req
+
+    def submit(self, model: str, line: str,
+               timeout_s: Optional[float] = None) -> str:
+        """Blocking submit: returns the response line or raises the typed
+        error.  Default wait bound covers the request timeout plus dispatch
+        slack so a wedged dispatcher surfaces as RequestTimeout, not a hang."""
+        if timeout_s is None:
+            timeout_s = self.request_timeout_s + 30.0
+        return self.submit_nowait(model, line).wait(timeout_s)
+
+    # -- dispatch loop (one thread) ------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.max_bucket
+
+    def _ready(self, now: float) -> List[str]:
+        out = []
+        for name, queue in self._queues.items():
+            if not queue:
+                continue
+            if (len(queue) >= self.max_bucket
+                    or now - queue[0].enqueued >= self.flush_deadline_s):
+                out.append(name)
+        return out
+
+    def _next_wait(self, now: float) -> Optional[float]:
+        deadlines = [queue[0].enqueued + self.flush_deadline_s - now
+                     for queue in self._queues.values() if queue]
+        if not deadlines:
+            return None                   # sleep until a submit notifies
+        return max(min(deadlines), 0.0)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and not self._ready(time.monotonic()):
+                    self._cond.wait(timeout=self._next_wait(time.monotonic()))
+                if self._stop and not any(self._queues.values()):
+                    return
+                ready = ([name for name, q in self._queues.items() if q]
+                         if self._stop else self._ready(time.monotonic()))
+                batches: List[Tuple[str, List[PendingRequest]]] = []
+                for name in ready:
+                    queue = self._queues[name]
+                    take = min(len(queue), self.max_bucket)
+                    batches.append((name,
+                                    [queue.popleft() for _ in range(take)]))
+            for name, reqs in batches:
+                self._dispatch(name, reqs)
+
+    def _dispatch(self, model: str, reqs: List[PendingRequest]) -> None:
+        group = f"Serving.{model}"
+        now = time.monotonic()
+        live: List[PendingRequest] = []
+        for req in reqs:
+            if now - req.enqueued > self.request_timeout_s:
+                self.counters.increment(group, "timeouts")
+                req.finish(error=RequestTimeout(
+                    f"request waited past "
+                    f"{self.request_timeout_s * 1e3:.0f} ms before dispatch"))
+            else:
+                live.append(req)
+        if not live:
+            return
+        entry = self.registry.get(model)
+        bucket = self._bucket_for(len(live))
+        try:
+            outs = entry.score_lines([r.line for r in live], bucket)
+        except Exception as exc:
+            # one bad row must not poison its coalesced batch neighbors:
+            # re-score each request alone (smallest bucket — warmed, so no
+            # recompile) so only the genuinely bad ones fail typed
+            if len(live) > 1:
+                self._dispatch_isolated(entry, group, live)
+                return
+            self.counters.increment(group, "errors")
+            err = (exc if isinstance(exc, ServingError)
+                   else RequestError(f"{type(exc).__name__}: {exc}"))
+            live[0].finish(error=err)
+            return
+        self._finish_scored(entry, group, model, live, outs, bucket)
+
+    def _dispatch_isolated(self, entry, group: str,
+                           reqs: List[PendingRequest]) -> None:
+        """Failure-isolation path: score each request of a failed batch
+        alone; good rows still succeed, bad rows carry their own error."""
+        model = reqs[0].model
+        bucket = self._bucket_for(1)
+        for req in reqs:
+            try:
+                outs = entry.score_lines([req.line], bucket)
+            except Exception as exc:
+                self.counters.increment(group, "errors")
+                req.finish(error=(exc if isinstance(exc, ServingError)
+                                  else RequestError(
+                                      f"{type(exc).__name__}: {exc}")))
+                continue
+            self._finish_scored(entry, group, model, [req], outs, bucket)
+
+    def _finish_scored(self, entry, group: str, model: str,
+                       live: List[PendingRequest], outs: List[str],
+                       bucket: int) -> None:
+        fresh = entry.compile_keys - self._known_keys[model]
+        if fresh:
+            # a shape outside the warmed set means this batch paid a compile
+            # on the hot path — the invariant violation the counter exposes
+            self._known_keys[model] |= fresh
+            self.counters.increment(group, "recompiles", len(fresh))
+        done = time.monotonic()
+        tracker = self.latency[model]
+        for req, out in zip(live, outs):
+            req.finish(result=out)
+            tracker.record(done - req.enqueued)
+        self.counters.increment(group, "requests", len(live))
+        self.counters.increment(group, "batches")
+        self.counters.increment(group, f"bucket.{bucket}")
+
+    # -- observability / shutdown --------------------------------------------
+    def stats(self) -> Dict[str, dict]:
+        return serving_stats(self.counters, self.latency)
+
+    def close(self) -> None:
+        """Flush every pending request, then stop the dispatcher."""
+        with self._cond:
+            if self._stop:
+                return
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=60.0)
+
+    def __enter__(self) -> "BucketedMicrobatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

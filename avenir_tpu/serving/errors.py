@@ -1,0 +1,43 @@
+"""Typed serving-plane errors.
+
+Every failure mode a client can observe has its own type, so front ends map
+them to distinct transport codes (HTTP status / RESP error tag) and callers
+can retry intelligently: shed and timeout are load signals (retry elsewhere
+or later), unknown-model and bad-request are permanent for that request.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base of every scoring-plane failure."""
+
+    code = "ERR"
+
+
+class UnknownModelError(ServingError):
+    """Request names a model the registry never loaded."""
+
+    code = "UNKNOWN_MODEL"
+
+
+class ShedError(ServingError):
+    """Queue-depth backpressure: the model's pending queue is full, the
+    request was rejected at submit (never enqueued) — the scoring-plane
+    analog of Storm's ``max.spout.pending`` refusing new tuples."""
+
+    code = "SHED"
+
+
+class RequestTimeout(ServingError):
+    """The request aged past ``serve.request.timeout.ms`` before a batch
+    picked it up (sustained overload past what backpressure absorbs)."""
+
+    code = "TIMEOUT"
+
+
+class RequestError(ServingError):
+    """The request payload itself is unservable (wrong column count,
+    unknown sequence symbol, sequence longer than the padded length, ...)."""
+
+    code = "BAD_REQUEST"

@@ -1,0 +1,240 @@
+"""Transport front ends for the scoring plane.
+
+Two transports, both stdlib-only:
+
+- :class:`ScoreHTTPServer` — a ``http.server`` JSON endpoint
+  (``POST /score`` with ``{"model": ..., "rows": [...]}``) plus health and
+  stats endpoints.  Typed serving errors map to distinct HTTP statuses so a
+  load balancer can tell shed (429) from overload timeout (504) from a bad
+  request (400).
+- :class:`QueueScoreFrontend` — a RESP-list transport over the same
+  push/pop queue surface the RL serving loop uses (``pipeline/resp.py``'s
+  ``RedisListQueue``, or the in-proc queue for tests): clients LPUSH
+  ``requestId,model,<csv row>`` onto a request list and collect
+  ``requestId,<response line>`` (or ``requestId,ERR,<code>,<message>``)
+  from a response list — so the reference's own Redis simulators can drive
+  the scoring plane exactly like they drive the Storm topology
+  (``ReinforcementLearnerTopology``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from avenir_tpu.serving.batcher import BucketedMicrobatcher, PendingRequest
+from avenir_tpu.serving.errors import (
+    RequestError,
+    RequestTimeout,
+    ServingError,
+    ShedError,
+    UnknownModelError,
+)
+
+_HTTP_STATUS = {
+    UnknownModelError: 404,
+    ShedError: 429,
+    RequestTimeout: 504,
+    RequestError: 400,
+}
+
+
+def _status_for(err: ServingError) -> int:
+    return _HTTP_STATUS.get(type(err), 500)
+
+
+class ScoreHTTPServer:
+    """Threaded HTTP front end over a :class:`BucketedMicrobatcher`.
+
+    Concurrent POSTs are the microbatching win: each handler thread submits
+    its rows and blocks, and the dispatcher folds every model's concurrent
+    rows into one padded bucket.  Port 0 binds an ephemeral port (tests);
+    ``serve.http.port`` configures a fixed one (docs/deployment.md).
+    """
+
+    def __init__(self, batcher: BucketedMicrobatcher,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.batcher = batcher
+        self.started = time.monotonic()
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):      # no per-request stderr spam
+                pass
+
+            def _send(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {
+                        "status": "ok",
+                        "models": outer.batcher.registry.names(),
+                        "buckets": outer.batcher.buckets,
+                        "uptime_sec": round(
+                            time.monotonic() - outer.started, 3)})
+                elif self.path == "/stats":
+                    self._send(200, outer.batcher.stats())
+                else:
+                    self._send(404, {"error": "NOT_FOUND",
+                                     "message": self.path})
+
+            def do_POST(self):
+                if self.path != "/score":
+                    self._send(404, {"error": "NOT_FOUND",
+                                     "message": self.path})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    model = req["model"]
+                    rows = req["rows"]
+                    if isinstance(rows, str):
+                        rows = [rows]
+                except (ValueError, KeyError, TypeError) as exc:
+                    self._send(400, {
+                        "error": "BAD_REQUEST",
+                        "message": f"body must be JSON "
+                                   f'{{"model": ..., "rows": [...]}}: {exc}'})
+                    return
+                try:
+                    results = outer.score_rows(model, rows)
+                except ServingError as err:
+                    self._send(_status_for(err),
+                               {"error": err.code, "message": str(err)})
+                    return
+                self._send(200, {"model": model, "results": results})
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    def score_rows(self, model: str, rows: List[str]) -> List[str]:
+        """Submit all rows (they microbatch together), wait for all.  The
+        first typed error aborts the call; rows already queued behind it
+        still score and are discarded — shed/timeout accounting stays
+        truthful either way."""
+        pending: List[PendingRequest] = [
+            self.batcher.submit_nowait(model, row) for row in rows]
+        return [p.wait(self.batcher.request_timeout_s + 30.0)
+                for p in pending]
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def start(self) -> "ScoreHTTPServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "ScoreHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class QueueScoreFrontend:
+    """RESP-list (or in-proc queue) front end.
+
+    ``requests``/``responses`` are any objects with the ``push``/``drain``
+    queue surface (``pipeline/resp.py::RedisListQueue``,
+    ``pipeline/streaming.py::InProcQueue``).  Message contract:
+
+    - request:  ``<requestId>,<model>,<csv row>``  (split on the first two
+      delimiters only — the payload keeps its own delimiters)
+    - response: ``<requestId>,<response line>`` on success,
+      ``<requestId>,ERR,<code>,<message>`` on a typed failure.
+    """
+
+    def __init__(self, batcher: BucketedMicrobatcher, requests, responses,
+                 delim: str = ","):
+        self.batcher = batcher
+        self.requests = requests
+        self.responses = responses
+        self.delim = delim
+
+    def _fail(self, rid: str, err: ServingError) -> None:
+        msg = str(err).replace("\n", " ").replace(self.delim, ";")
+        self.responses.push(
+            self.delim.join([rid, "ERR", err.code, msg]))
+
+    def poll_once(self) -> int:
+        """Drain the request list, submit everything (so concurrent clients
+        microbatch), then push responses; returns messages consumed."""
+        msgs = self.requests.drain()
+        pending: List[Tuple[str, PendingRequest]] = []
+        for msg in msgs:
+            parts = msg.split(self.delim, 2)
+            if len(parts) != 3:
+                self._fail(msg, RequestError(
+                    "request must be 'requestId,model,<csv row>'"))
+                continue
+            rid, model, payload = parts
+            try:
+                pending.append((rid, self.batcher.submit_nowait(model,
+                                                                payload)))
+            except ServingError as err:
+                self._fail(rid, err)
+        for rid, req in pending:
+            try:
+                out = req.wait(self.batcher.request_timeout_s + 30.0)
+            except ServingError as err:
+                self._fail(rid, err)
+                continue
+            self.responses.push(f"{rid}{self.delim}{out}")
+        return len(msgs)
+
+    def run(self, max_messages: Optional[int] = None,
+            idle_sleep_s: float = 0.005,
+            idle_limit_s: Optional[float] = None) -> int:
+        """Poll until ``max_messages`` are served, or the request list stays
+        empty for ``idle_limit_s`` (None = poll forever)."""
+        served = 0
+        idle_since = time.monotonic()
+        while max_messages is None or served < max_messages:
+            n = self.poll_once()
+            if n:
+                served += n
+                idle_since = time.monotonic()
+                continue
+            if idle_limit_s is not None and \
+                    time.monotonic() - idle_since >= idle_limit_s:
+                break
+            time.sleep(idle_sleep_s)
+        return served
+
+
+def redis_score_frontend(batcher: BucketedMicrobatcher,
+                         host: str = "localhost", port: int = 6379,
+                         db: int = 0,
+                         request_queue: str = "scoreRequestQueue",
+                         response_queue: str = "scoreResponseQueue",
+                         ) -> QueueScoreFrontend:
+    """The Redis wiring of :class:`QueueScoreFrontend` over the in-tree
+    stdlib RESP client — the scoring-plane twin of the RL loop's
+    RedisEventSource/RedisActionWriter transports."""
+    from avenir_tpu.pipeline.resp import RedisListQueue, RespClient
+
+    client = RespClient(host, port, db=db)
+    return QueueScoreFrontend(
+        batcher,
+        RedisListQueue(request_queue, client=client),
+        RedisListQueue(response_queue,
+                       client=RespClient(host, port, db=db)))

@@ -1,4 +1,4 @@
-"""Validation metrics, arbitration, and counters.
+"""Validation metrics, arbitration, counters, and latency tracking.
 
 Replaces the reference's validation-mode machinery: the binary confusion
 matrix with ×100 integer accuracy/recall/precision published as Hadoop
@@ -7,9 +7,16 @@ bayesian/BayesianPredictor.java:170-180 and knn/NearestNeighbor.java:300-312),
 the misclassification-cost arbitrator (util/CostBasedArbitrator.java:35-45),
 and the Hadoop counter channel itself (here a plain named-counter object
 returned alongside results).
+
+:class:`LatencyTracker` + :func:`serving_stats` are the shared observability
+schema of BOTH online paths — the scoring plane (``serving/batcher.py``) and
+the RL serving loop (``pipeline/streaming.py``) — so their health endpoints
+and benchmark artifacts report identically.
 """
 
 from __future__ import annotations
+
+import threading
 
 from typing import Dict, List, Optional, Sequence
 
@@ -17,22 +24,34 @@ import numpy as np
 
 
 class Counters:
-    """Named counters — the in-process stand-in for Hadoop job counters."""
+    """Named counters — the in-process stand-in for Hadoop job counters.
+
+    Increment is a read-modify-write, and one Counters may be shared across
+    serving threads (frontend handlers, fleet workers aggregating into one
+    report), so mutations take a lock — the Hadoop counter channel was
+    task-concurrent too.
+    """
 
     def __init__(self):
         self._groups: Dict[str, Dict[str, int]] = {}
+        self._lock = threading.Lock()
 
     def increment(self, group: str, name: str, amount: int = 1) -> None:
-        self._groups.setdefault(group, {})[name] = self.get(group, name) + amount
+        with self._lock:
+            g = self._groups.setdefault(group, {})
+            g[name] = g.get(name, 0) + amount
 
     def set(self, group: str, name: str, value: int) -> None:
-        self._groups.setdefault(group, {})[name] = int(value)
+        with self._lock:
+            self._groups.setdefault(group, {})[name] = int(value)
 
     def get(self, group: str, name: str) -> int:
-        return self._groups.get(group, {}).get(name, 0)
+        with self._lock:
+            return self._groups.get(group, {}).get(name, 0)
 
     def as_dict(self) -> Dict[str, Dict[str, int]]:
-        return {g: dict(d) for g, d in self._groups.items()}
+        with self._lock:
+            return {g: dict(d) for g, d in self._groups.items()}
 
     def merge(self, other: "Counters") -> "Counters":
         """Adopt every counter from ``other`` (overwriting same-named ones)."""
@@ -47,6 +66,69 @@ class Counters:
             for n in sorted(self._groups[g]):
                 lines.append(f"{g}::{n} = {self._groups[g][n]}")
         return "\n".join(lines)
+
+
+class LatencyTracker:
+    """Per-request latency percentiles over a bounded ring of recent samples.
+
+    A ring (default 8192 samples) rather than an unbounded list: a serving
+    loop alive for days must not grow host memory per request, and recent
+    samples are what a health endpoint should describe.  Thread-safe
+    (requests complete on dispatch/worker threads while a frontend thread
+    reads the percentiles).
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self._buf = np.zeros(max(int(capacity), 1), np.float64)
+        self._next = 0
+        self._filled = 0
+        self.count = 0                      # total samples ever recorded
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._next] = seconds
+            self._next = (self._next + 1) % len(self._buf)
+            self._filled = min(self._filled + 1, len(self._buf))
+            self.count += 1
+
+    def percentile(self, q: float) -> float:
+        """q-th percentile in seconds over the retained window (0.0 when
+        no sample was recorded yet)."""
+        with self._lock:
+            if not self._filled:
+                return 0.0
+            return float(np.percentile(self._buf[:self._filled], q))
+
+    @property
+    def p50_ms(self) -> float:
+        return self.percentile(50.0) * 1e3
+
+    @property
+    def p99_ms(self) -> float:
+        return self.percentile(99.0) * 1e3
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"p50_ms": round(self.p50_ms, 4),
+                "p99_ms": round(self.p99_ms, 4),
+                "latency_samples": self.count}
+
+
+def serving_stats(counters: "Counters",
+                  latency: Dict[str, LatencyTracker]) -> Dict[str, dict]:
+    """The one stats schema both online paths publish: per served model,
+    the ``Serving.<name>`` counter group merged with its latency
+    percentiles.  Counter names inside the group: ``requests``, ``batches``,
+    ``shed``, ``timeouts``, ``errors``, ``recompiles`` and the batched-size
+    histogram ``bucket.<n>`` (the RL loop, which dispatches one event at a
+    time, reports everything under ``bucket.1``)."""
+    groups = counters.as_dict()
+    out: Dict[str, dict] = {}
+    for name, tracker in latency.items():
+        stats = dict(groups.get(f"Serving.{name}", {}))
+        stats.update(tracker.snapshot())
+        out[name] = stats
+    return out
 
 
 class ConfusionMatrix:

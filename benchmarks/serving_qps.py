@@ -1,7 +1,14 @@
 #!/usr/bin/env python
 """Serving-path benchmark: events/sec + action latency through the
 ShardedServingFleet (the Storm-topology capacity analog,
-ReinforcementLearnerTopology.java:42-85). Prints one JSON line.
+ReinforcementLearnerTopology.java:42-85), plus the ServeGraft scoring
+plane: QPS + p50/p99 per model family per bucket size through the bucketed
+microbatcher, with the zero-steady-state-recompiles invariant ASSERTED
+(the compile-cache discipline is the whole point of bucketing — a recompile
+on the hot path voids the measurement).  Prints one JSON line; the
+scoring-plane section is canary-conditioned per the PR-2 convention (a
+fresh matmul canary rides in the artifact so a slow rig indicts itself,
+not the kernel).
 
 Workload: G engagement groups, each its own intervalEstimator learner over
 5 actions (the reference runs one topology per group); events round-robin
@@ -151,11 +158,153 @@ def gil_contention_probe(n_events: int = 3000, burn_loops: int = 60_000):
     return out
 
 
+# ---------------------------------------------------------------------------
+# the scoring plane (ServeGraft) — QPS + latency per family per bucket
+# ---------------------------------------------------------------------------
+
+SCORE_BUCKETS = (1, 8, 32)
+
+
+def _build_serving_workspace(root: str):
+    """Train every family's artifact with the real jobs (tiny datasets) and
+    return {family: (serve conf, request lines)} — the benchmark measures
+    the same artifact-handoff path production serving uses."""
+    import os
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+    from avenir_tpu.datagen.retarget import (
+        RETARGET_SCHEMA_JSON,
+        generate_retarget,
+    )
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    j = lambda *p: os.path.join(root, *p)
+    rows = generate_churn(1200, seed=7)
+    write_csv(j("train.csv"), rows[:800])
+    write_csv(j("test.csv"), rows[800:])
+    with open(j("churn.json"), "w") as fh:
+        fh.write(json.dumps(CHURN_SCHEMA_JSON))
+    churn = {"feature.schema.file.path": j("churn.json")}
+    get_job("BayesianDistribution").run(JobConfig(dict(churn)),
+                                        j("train.csv"), j("nb_model"))
+    get_job("LogisticRegressionJob").run(
+        JobConfig({**churn, "coeff.file.path": j("coeff.txt"),
+                   "iteration.limit": "15"}),
+        j("train.csv"), j("lr_out"))
+    rrows = generate_retarget(1500, seed=3)
+    write_csv(j("rdata.csv"), rrows)
+    with open(j("retarget.json"), "w") as fh:
+        fh.write(json.dumps(RETARGET_SCHEMA_JSON))
+    retarget = {"feature.schema.file.path": j("retarget.json")}
+    get_job("DecisionTreeBuilder").run(JobConfig(dict(retarget)),
+                                       j("rdata.csv"), j("tree_model"))
+    os.mkdir(j("tagged"))
+    with open(j("tagged", "part-00000"), "w") as fh:
+        fh.write("c1,x:A,y:B,x:A\nc2,y:B,y:B,x:A\nc3,x:A,y:B,x:A,x:A\n")
+    get_job("HiddenMarkovModelBuilder").run(JobConfig({}), j("tagged"),
+                                            j("hmm_model"))
+
+    churn_lines = read_lines(j("test.csv"))
+    seq_lines = [f"u{i},{i % 9},{'x,y,x,y'[: 1 + 2 * (i % 4)]}"
+                 for i in range(400)]
+    return {
+        "naiveBayes": (JobConfig({**churn,
+                                  "bayesian.model.file.path": j("nb_model"),
+                                  "serve.models": "naiveBayes"}),
+                       churn_lines),
+        "logistic": (JobConfig({**churn, "coeff.file.path": j("coeff.txt"),
+                                "serve.models": "logistic"}), churn_lines),
+        "tree": (JobConfig({**retarget,
+                            "tree.model.file.path": j("tree_model"),
+                            "serve.models": "tree"}),
+                 read_lines(j("rdata.csv"))),
+        "knn": (JobConfig({**churn, "training.data.path": j("train.csv"),
+                           "top.match.count": "7",
+                           "kernel.function": "gaussian",
+                           "serve.models": "knn"}), churn_lines),
+        "viterbi": (JobConfig({"hmm.model.file.path": j("hmm_model"),
+                               "skip.field.count": "2",
+                               "serve.models": "viterbi",
+                               "serve.sequence.pad.len": "16"}), seq_lines),
+    }
+
+
+def scoring_plane_section(bursts_per_bucket: int = 40):
+    """{family: {bucket: {qps, p50_ms, p99_ms}}, steady_state_recompiles}.
+
+    Per (family, bucket): submit ``bursts_per_bucket`` bucket-sized bursts
+    through the warmed microbatcher (submit_nowait the burst, wait all —
+    the dispatcher folds each burst into exactly one padded bucket), report
+    rows/sec and per-burst p50/p99.  After ALL steady-state traffic the
+    recompiles counter must read zero for every family — asserted, and
+    published so the artifact carries the proof."""
+    import tempfile
+
+    from avenir_tpu.serving.batcher import BucketedMicrobatcher
+    from avenir_tpu.serving.registry import ModelRegistry
+
+    out = {}
+    total_recompiles = 0
+    with tempfile.TemporaryDirectory(prefix="servegraft_bench_") as root:
+        families = _build_serving_workspace(root)
+        for family, (conf, lines) in families.items():
+            conf.set("serve.bucket.sizes",
+                     ",".join(str(b) for b in SCORE_BUCKETS))
+            conf.set("serve.flush.deadline.ms", "2")
+            registry = ModelRegistry.from_conf(conf)
+            batcher = BucketedMicrobatcher.from_conf(registry, conf)
+            fam_stats = {}
+            try:
+                for bucket in SCORE_BUCKETS:
+                    burst_lat = []
+                    rows_done = 0
+                    t0 = time.perf_counter()
+                    for burst in range(bursts_per_bucket):
+                        take = [lines[(burst * bucket + i) % len(lines)]
+                                for i in range(bucket)]
+                        tb = time.perf_counter()
+                        pend = [batcher.submit_nowait(family, ln)
+                                for ln in take]
+                        for p in pend:
+                            p.wait(60.0)
+                        burst_lat.append(time.perf_counter() - tb)
+                        rows_done += bucket
+                    dt = time.perf_counter() - t0
+                    lat = np.asarray(burst_lat)
+                    fam_stats[str(bucket)] = {
+                        "qps": round(rows_done / dt, 1),
+                        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+                    }
+                recompiles = batcher.counters.get(f"Serving.{family}",
+                                                  "recompiles")
+                if recompiles != 0:
+                    # a hot-path compile voids the timings — hard failure
+                    # even under python -O (so no `assert`)
+                    raise RuntimeError(
+                        f"{family}: {recompiles} steady-state recompile(s) "
+                        f"— a shape escaped the warmed bucket set")
+                fam_stats["steady_state_recompiles"] = recompiles
+            finally:
+                batcher.close()
+            out[family] = fam_stats
+            total_recompiles += recompiles
+    out["steady_state_recompiles_total"] = total_recompiles
+    return out
+
+
 def main():
     rates = {w: round(fleet_events_per_sec(w), 1) for w in (1, 2, 4)}
     proc_rates = {w: round(process_fleet_events_per_sec(w), 1)
                   for w in (1, 2, 4)}
     lats = single_event_latencies()
+    # fresh canary right before the scoring-plane section (PR-2 convention):
+    # inflated canary ⇒ the rig was loaded, not the serving plane slow
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
+    canary_ms = matmul_canary_ms()
     print(json.dumps({
         "metric": "serving_events_per_sec",
         "value": max(rates.values()),
@@ -167,6 +316,8 @@ def main():
         "groups": 32,
         "learner": "intervalEstimator",
         "gil_contention_1worker": gil_contention_probe(),
+        "canary_matmul_4096_bf16_ms": round(canary_ms, 2),
+        "scoring_plane": scoring_plane_section(),
     }))
 
 

@@ -351,9 +351,13 @@ def test_cli_clean_exits_zero(tmp_path):
 # -- the live gate: the whole tree, as CI ---------------------------------
 
 def test_whole_tree_zero_nonbaselined_findings():
+    # tests/test_serving.py rides the gate too (round 9): serving tests
+    # drive the hot dispatch loop directly, exactly where a per-iteration
+    # host sync (GL005) or an undocumented serve.* key (GL004) would hide
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
-         str(REPO / "bench.py")], root=str(REPO))
+         str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py")],
+        root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (
         "graftlint found new hazards (fix them, suppress with a "

@@ -31,6 +31,12 @@ class _FakeRedisHandler(socketserver.BaseRequestHandler):
                 if cmd is None:
                     break
                 buf = buf2
+                # transport-fault injection: close the connection WITHOUT
+                # executing the parsed command (so a client retry is
+                # exactly-once) — models a server restart / idle reap
+                if getattr(self.server, "drop_next", False):
+                    self.server.drop_next = False
+                    return
                 self.request.sendall(self._execute(cmd))
 
     def _parse(self, buf):
@@ -163,6 +169,75 @@ def test_redis_list_queue_drain_fallback(fake_redis, request):
         assert q.drain() == ["d"]        # stays on the fallback path
     finally:
         srv.rpop_count_ok = True
+
+
+def test_resp_client_reconnects_after_dropped_connection(fake_redis_server):
+    """A connection the server drops mid-stream (restart, idle reap) must be
+    absorbed by command(): reconnect once, resend, return the reply — the
+    dropped command was never executed, so the retry is exactly-once here."""
+    host, port = fake_redis_server.server_address
+    c = RespClient(host, port)
+    assert c.lpush("q", "a") == 1
+    fake_redis_server.drop_next = True
+    # the dropped connection surfaces as a clean close (recv b"") or a
+    # reset depending on timing; both must be retried transparently
+    assert c.lpush("q", "b") == 2
+    assert c.reconnects == 1
+    assert c.rpop("q") == "a" and c.rpop("q") == "b"
+    c.close()
+
+
+def test_resp_client_reconnect_preserves_db_selection(fake_redis_server):
+    """The retry path must re-SELECT the client's db on the new connection
+    (a reconnected client silently back on db 0 is the classic footgun)."""
+    host, port = fake_redis_server.server_address
+    commands = []
+    orig = _FakeRedisHandler._execute
+
+    def spy(self, args):
+        commands.append([a.upper() if i == 0 else a
+                         for i, a in enumerate(args)])
+        return orig(self, args)
+
+    _FakeRedisHandler._execute = spy
+    try:
+        c = RespClient(host, port, db=3)
+        fake_redis_server.drop_next = True
+        assert c.ping()
+        assert c.reconnects == 1
+        selects = [cmd for cmd in commands if cmd[0] == "SELECT"]
+        assert len(selects) == 2 and selects[-1][1] == "3"
+    finally:
+        _FakeRedisHandler._execute = orig
+    c.close()
+
+
+def test_resp_client_gives_up_after_one_retry(fake_redis_server):
+    """Two consecutive transport faults on one command must raise — the
+    retry budget is exactly one reconnect per command()."""
+    host, port = fake_redis_server.server_address
+    c = RespClient(host, port)
+    assert c.ping()
+    # first fault: the live handler drops the connection; second fault: the
+    # listener is gone, so the one reconnect attempt is refused
+    fake_redis_server.drop_next = True
+    fake_redis_server.shutdown()
+    fake_redis_server.server_close()
+    with pytest.raises(OSError):
+        c.ping()
+    c.close()
+
+
+def test_redis_list_queue_survives_server_drop(fake_redis_server):
+    """The queue surface the serving loops use rides the same retry: a
+    drain() spanning a dropped connection still empties the list."""
+    host, port = fake_redis_server.server_address
+    q = RedisListQueue("events", host=host, port=port)
+    for i in range(5):
+        q.push(f"m{i}")
+    fake_redis_server.drop_next = True
+    assert q.drain() == [f"m{i}" for i in range(5)]
+    assert q.client.reconnects == 1
 
 
 def test_lead_gen_closed_loop_over_redis(fake_redis):

@@ -1,0 +1,499 @@
+"""ServeGraft scoring-plane tests.
+
+The heart is batch-vs-serving parity: for every model family, the serving
+path's responses must be BYTE-IDENTICAL to the corresponding batch
+predictor's output on the same rows — the registry routes scoring through
+the same model-layer entries the jobs use, and these tests pin that
+contract (including kernel-weighted kNN and Viterbi state sequences).
+Around it: bucketing/padding semantics, warmup vs recompiles, typed
+shed/timeout/bad-request errors, both front ends, the driver `serve`
+stage, and the shared RL-loop metrics schema.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import ConfigError, JobConfig
+from avenir_tpu.core.csv_io import write_csv
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.datagen.retarget import RETARGET_SCHEMA_JSON, generate_retarget
+from avenir_tpu.jobs import get_job
+from avenir_tpu.jobs.base import read_lines
+from avenir_tpu.serving import (
+    BucketedMicrobatcher,
+    ModelRegistry,
+    QueueScoreFrontend,
+    RequestError,
+    RequestTimeout,
+    ScoreHTTPServer,
+    ShedError,
+    UnknownModelError,
+)
+
+
+# ---------------------------------------------------------------------------
+# trained artifacts (once per module, through the real jobs)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    root = tmp_path_factory.mktemp("servegraft")
+    j = lambda *p: str(root.joinpath(*p))
+    rows = generate_churn(600, seed=7)
+    write_csv(j("train.csv"), rows[:480])
+    write_csv(j("test.csv"), rows[480:])
+    root.joinpath("churn.json").write_text(json.dumps(CHURN_SCHEMA_JSON))
+    churn = {"feature.schema.file.path": j("churn.json")}
+    get_job("BayesianDistribution").run(JobConfig(dict(churn)),
+                                        j("train.csv"), j("nb_model"))
+    get_job("LogisticRegressionJob").run(
+        JobConfig({**churn, "coeff.file.path": j("coeff.txt"),
+                   "iteration.limit": "8"}),
+        j("train.csv"), j("lr_out"))
+    rrows = generate_retarget(1000, seed=3)
+    write_csv(j("rdata.csv"), rrows)
+    root.joinpath("retarget.json").write_text(json.dumps(RETARGET_SCHEMA_JSON))
+    retarget = {"feature.schema.file.path": j("retarget.json")}
+    get_job("DecisionTreeBuilder").run(JobConfig(dict(retarget)),
+                                       j("rdata.csv"), j("tree_model"))
+    tagged = root.joinpath("tagged")
+    tagged.mkdir()
+    tagged.joinpath("part-00000").write_text(
+        "c1,x:A,y:B,x:A\nc2,y:B,y:B\nc3,x:A,y:B,x:A,x:A\n")
+    get_job("HiddenMarkovModelBuilder").run(JobConfig({}), str(tagged),
+                                            j("hmm_model"))
+    return {"j": j, "churn": churn, "retarget": retarget}
+
+
+def _batcher(conf_props, **kwargs):
+    conf = JobConfig(dict(conf_props))
+    registry = ModelRegistry.from_conf(conf)
+    return BucketedMicrobatcher.from_conf(registry, conf), conf, registry
+
+
+def _serve_all(batcher, model, lines, burst=5):
+    """Submit in bursts (so requests coalesce into buckets) and return the
+    responses in request order."""
+    out = []
+    for i in range(0, len(lines), burst):
+        pend = [batcher.submit_nowait(model, ln)
+                for ln in lines[i:i + burst]]
+        out.extend(p.wait(60.0) for p in pend)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# batch-vs-serving parity, one test per family
+# ---------------------------------------------------------------------------
+
+def test_naive_bayes_parity(ws):
+    j, churn = ws["j"], ws["churn"]
+    conf2 = JobConfig({**churn, "bayesian.model.file.path": j("nb_model")})
+    get_job("BayesianPredictor").run(conf2, j("test.csv"), j("nb_pred"))
+    batch = read_lines(j("nb_pred"))
+    b, _, _ = _batcher({**churn, "bayesian.model.file.path": j("nb_model"),
+                        "serve.models": "naiveBayes",
+                        "serve.bucket.sizes": "1,4,16"})
+    try:
+        served = _serve_all(b, "naiveBayes", read_lines(j("test.csv")))
+        assert served == batch
+        assert b.counters.get("Serving.naiveBayes", "recompiles") == 0
+    finally:
+        b.close()
+
+
+def test_knn_parity_with_kernel_weighting(ws):
+    j, churn = ws["j"], ws["churn"]
+    props = {**churn, "training.data.path": j("train.csv"),
+             "top.match.count": "7", "kernel.function": "gaussian",
+             "kernel.param": "0.25", "inverse.distance.weighted": "true"}
+    get_job("NearestNeighbor").run(JobConfig(dict(props)), j("test.csv"),
+                                   j("knn_pred"))
+    batch = read_lines(j("knn_pred"))
+    b, _, _ = _batcher({**props, "serve.models": "knn",
+                        "serve.bucket.sizes": "1,4"})
+    try:
+        served = _serve_all(b, "knn", read_lines(j("test.csv"))[:60],
+                            burst=4)
+        assert served == batch[:60]
+    finally:
+        b.close()
+
+
+def test_tree_parity(ws):
+    j, retarget = ws["j"], ws["retarget"]
+    conf2 = JobConfig({**retarget, "tree.model.file.path": j("tree_model")})
+    get_job("DecisionTreeBuilder").run(conf2, j("rdata.csv"), j("tree_pred"))
+    batch = read_lines(j("tree_pred"))
+    b, _, _ = _batcher({**retarget, "tree.model.file.path": j("tree_model"),
+                        "serve.models": "tree", "serve.bucket.sizes": "1,8"})
+    try:
+        served = _serve_all(b, "tree", read_lines(j("rdata.csv"))[:80],
+                            burst=7)
+        assert served == batch[:80]
+    finally:
+        b.close()
+
+
+def test_viterbi_parity_state_sequences(ws):
+    j = ws["j"]
+    seq_lines = ["u1,1,x,y,x", "u2,2,y", "u3,3,x,y,x,x,y", "u4,4,y,x",
+                 "u5,5,x", "u6,6,y,y,x,y"]
+    obs = os.path.dirname(j("obs", "part-00000"))
+    os.makedirs(obs, exist_ok=True)
+    with open(j("obs", "part-00000"), "w") as fh:
+        fh.write("\n".join(seq_lines) + "\n")
+    props = {"hmm.model.file.path": j("hmm_model"), "skip.field.count": "2"}
+    get_job("ViterbiStatePredictor").run(JobConfig(dict(props)), obs,
+                                         j("vit_pred"))
+    batch = read_lines(j("vit_pred"))
+    # serving pads every sequence to serve.sequence.pad.len, the batch job
+    # to the batch max — identical paths prove pad steps are identities
+    b, _, _ = _batcher({**props, "serve.models": "viterbi",
+                        "serve.bucket.sizes": "1,4",
+                        "serve.sequence.pad.len": "12"})
+    try:
+        served = _serve_all(b, "viterbi", seq_lines, burst=4)
+        assert served == batch
+    finally:
+        b.close()
+
+
+def test_logistic_parity(ws):
+    from avenir_tpu.jobs.base import Job
+    from avenir_tpu.models import logistic as mlr
+
+    j, churn = ws["j"], ws["churn"]
+    props = {**churn, "coeff.file.path": j("coeff.txt")}
+    conf = JobConfig(dict(props))
+    enc, ds, _ = Job.encode_input(conf, j("test.csv"), with_labels=False,
+                                  need_rows=False)
+    model = mlr.LogisticRegressionModel.from_history_lines(
+        read_lines(j("coeff.txt")))
+    probs, pred = mlr.predict_batch(model, mlr.design_matrix(ds))
+    lines = read_lines(j("test.csv"))
+    oracle = [f"{ln},{int(pred[i])},{probs[i]:.6f}"
+              for i, ln in enumerate(lines)]
+    b, _, _ = _batcher({**props, "serve.models": "logistic",
+                        "serve.bucket.sizes": "1,4,16"})
+    try:
+        assert _serve_all(b, "logistic", lines) == oracle
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# bucketing, padding, warmup, recompiles
+# ---------------------------------------------------------------------------
+
+def test_pad_rows_never_leak_and_histogram(ws):
+    """3 requests into a bucket-8 batch must score exactly like 3 lone
+    bucket-1 requests — pad rows influence nothing — and the size
+    histogram must show one bucket-8 batch."""
+    j, churn = ws["j"], ws["churn"]
+    lines = read_lines(j("test.csv"))[:3]
+    props = {**churn, "bayesian.model.file.path": j("nb_model"),
+             "serve.models": "naiveBayes"}
+    b1, _, _ = _batcher({**props, "serve.bucket.sizes": "1"})
+    try:
+        singles = [b1.submit("naiveBayes", ln) for ln in lines]
+    finally:
+        b1.close()
+    b8, _, _ = _batcher({**props, "serve.bucket.sizes": "8",
+                         "serve.flush.deadline.ms": "150"})
+    try:
+        pend = [b8.submit_nowait("naiveBayes", ln) for ln in lines]
+        batched = [p.wait(30.0) for p in pend]
+        assert batched == singles
+        assert b8.counters.get("Serving.naiveBayes", "bucket.8") == 1
+        assert b8.counters.get("Serving.naiveBayes", "batches") == 1
+    finally:
+        b8.close()
+
+
+def test_warmup_pins_compile_cache(ws):
+    """With warmup, steady state records zero recompiles; without it, the
+    first batch of each shape is counted — the invariant is measured."""
+    j, churn = ws["j"], ws["churn"]
+    props = {**churn, "bayesian.model.file.path": j("nb_model"),
+             "serve.models": "naiveBayes", "serve.bucket.sizes": "1,2"}
+    lines = read_lines(j("test.csv"))[:6]
+    warm, _, _ = _batcher(props)
+    try:
+        _serve_all(warm, "naiveBayes", lines, burst=2)
+        assert warm.counters.get("Serving.naiveBayes", "recompiles") == 0
+    finally:
+        warm.close()
+    cold, _, _ = _batcher({**props, "serve.warmup.on.start": "false"})
+    try:
+        _serve_all(cold, "naiveBayes", lines, burst=2)
+        assert cold.counters.get("Serving.naiveBayes", "recompiles") >= 1
+    finally:
+        cold.close()
+
+
+def test_shed_and_timeout_and_unknown_model(ws):
+    j, churn = ws["j"], ws["churn"]
+    props = {**churn, "bayesian.model.file.path": j("nb_model"),
+             "serve.models": "naiveBayes"}
+    line = read_lines(j("test.csv"))[0]
+    # shed: tiny queue, huge bucket + deadline so nothing drains
+    b, _, _ = _batcher({**props, "serve.bucket.sizes": "64",
+                        "serve.flush.deadline.ms": "5000",
+                        "serve.queue.depth": "3"})
+    try:
+        held = [b.submit_nowait("naiveBayes", line) for _ in range(3)]
+        with pytest.raises(ShedError):
+            b.submit_nowait("naiveBayes", line)
+        assert b.counters.get("Serving.naiveBayes", "shed") == 1
+        with pytest.raises(UnknownModelError):
+            b.submit_nowait("noSuchModel", line)
+    finally:
+        b.close()            # flushes the held requests
+    assert all(h.wait(1.0) for h in held)
+    # timeout: the request aged past the (zero) budget before dispatch
+    bt, _, _ = _batcher({**props, "serve.bucket.sizes": "8",
+                         "serve.flush.deadline.ms": "30",
+                         "serve.request.timeout.ms": "1"})
+    try:
+        import time
+
+        req = bt.submit_nowait("naiveBayes", line)
+        time.sleep(0.05)
+        with pytest.raises(RequestTimeout):
+            req.wait(30.0)
+        assert bt.counters.get("Serving.naiveBayes", "timeouts") == 1
+    finally:
+        bt.close()
+
+
+def test_bad_request_rows_fail_typed(ws):
+    j, churn = ws["j"], ws["churn"]
+    b, _, _ = _batcher({**churn, "bayesian.model.file.path": j("nb_model"),
+                        "serve.models": "naiveBayes",
+                        "serve.bucket.sizes": "1"})
+    try:
+        with pytest.raises(RequestError):
+            b.submit("naiveBayes", "too,few")
+    finally:
+        b.close()
+    vb, _, _ = _batcher({"hmm.model.file.path": j("hmm_model"),
+                         "skip.field.count": "2",
+                         "serve.models": "viterbi",
+                         "serve.bucket.sizes": "1",
+                         "serve.sequence.pad.len": "4"})
+    try:
+        with pytest.raises(RequestError):        # unknown symbol
+            vb.submit("viterbi", "u1,1,x,zzz")
+        with pytest.raises(RequestError):        # longer than the pad len
+            vb.submit("viterbi", "u1,1,x,y,x,y,x")
+    finally:
+        vb.close()
+
+
+def test_bad_request_does_not_poison_batch_neighbors(ws):
+    """A malformed row coalesced into the same bucket as valid concurrent
+    requests must fail alone: the batcher isolates a failed batch and
+    re-scores each member, so the valid rows still succeed."""
+    j, churn = ws["j"], ws["churn"]
+    good = read_lines(j("test.csv"))[:3]
+    b, _, _ = _batcher({**churn, "bayesian.model.file.path": j("nb_model"),
+                        "serve.models": "naiveBayes",
+                        "serve.bucket.sizes": "1,8",
+                        "serve.flush.deadline.ms": "100"})
+    try:
+        oracle = [b.submit("naiveBayes", ln) for ln in good]
+        pend = [b.submit_nowait("naiveBayes", ln)
+                for ln in [good[0], "too,few", good[1], good[2]]]
+        assert pend[0].wait(30.0) == oracle[0]
+        with pytest.raises(RequestError):
+            pend[1].wait(30.0)
+        assert [pend[2].wait(30.0), pend[3].wait(30.0)] == oracle[1:]
+        assert b.counters.get("Serving.naiveBayes", "errors") == 1
+    finally:
+        b.close()
+
+
+def test_registry_config_errors(ws):
+    with pytest.raises(ConfigError):
+        ModelRegistry.from_conf(JobConfig({}))               # no serve.models
+    with pytest.raises(ConfigError):
+        ModelRegistry.from_conf(JobConfig({"serve.models": "hologram"}))
+    with pytest.raises(ConfigError):                         # missing artifact
+        ModelRegistry.from_conf(JobConfig({"serve.models": "naiveBayes"}))
+
+
+# ---------------------------------------------------------------------------
+# front ends
+# ---------------------------------------------------------------------------
+
+def test_http_frontend_score_health_stats(ws):
+    j, churn = ws["j"], ws["churn"]
+    b, _, _ = _batcher({**churn, "bayesian.model.file.path": j("nb_model"),
+                        "serve.models": "naiveBayes",
+                        "serve.bucket.sizes": "1,4"})
+    lines = read_lines(j("test.csv"))[:5]
+    singles = [b.submit("naiveBayes", ln) for ln in lines]
+    with ScoreHTTPServer(b) as srv:
+        host, port = srv.address
+        base = f"http://{host}:{port}"
+
+        def post(payload, expect_status=200):
+            req = urllib.request.Request(
+                f"{base}/score", data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        status, body = post({"model": "naiveBayes", "rows": lines})
+        assert status == 200 and body["results"] == singles
+        status, body = post({"model": "noSuch", "rows": lines[:1]})
+        assert status == 404 and body["error"] == "UNKNOWN_MODEL"
+        status, body = post({"rows": lines[:1]})
+        assert status == 400
+        with urllib.request.urlopen(f"{base}/healthz") as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok" and health["models"] == ["naiveBayes"]
+        with urllib.request.urlopen(f"{base}/stats") as resp:
+            stats = json.loads(resp.read())
+        assert stats["naiveBayes"]["requests"] >= 10
+        assert "p99_ms" in stats["naiveBayes"]
+    b.close()
+
+
+def test_queue_frontend_inproc_and_resp_socket(ws):
+    """The RESP-list transport end to end: first over in-proc queues, then
+    over real sockets against the fake Redis server — the reference's own
+    Redis simulators can drive the scoring plane like the Storm path."""
+    from test_resp import _FakeRedisHandler
+
+    import socketserver
+
+    from avenir_tpu.pipeline.resp import RedisListQueue
+    from avenir_tpu.pipeline.streaming import InProcQueue
+
+    j, churn = ws["j"], ws["churn"]
+    b, _, _ = _batcher({**churn, "bayesian.model.file.path": j("nb_model"),
+                        "serve.models": "naiveBayes",
+                        "serve.bucket.sizes": "1,4"})
+    lines = read_lines(j("test.csv"))[:4]
+    singles = [b.submit("naiveBayes", ln) for ln in lines]
+
+    def check_transport(requests, responses):
+        fe = QueueScoreFrontend(b, requests, responses)
+        for i, ln in enumerate(lines):
+            requests.push(f"r{i},naiveBayes,{ln}")
+        requests.push("r9,noSuchModel,x")
+        requests.push("malformed-no-delims")
+        assert fe.poll_once() == len(lines) + 2
+        got = {}
+        for msg in responses.drain():
+            rid, _, rest = msg.partition(",")
+            got[rid] = rest
+        for i in range(len(lines)):
+            assert got[f"r{i}"] == singles[i]
+        assert got["r9"].startswith("ERR,UNKNOWN_MODEL")
+        assert got["malformed-no-delims"].startswith("ERR,BAD_REQUEST")
+
+    check_transport(InProcQueue(), InProcQueue())
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                          _FakeRedisHandler)
+    srv.daemon_threads = True
+    import collections
+
+    srv.lists = collections.defaultdict(collections.deque)
+    srv.lock = threading.Lock()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = srv.server_address
+        check_transport(
+            RedisListQueue("scoreRequestQueue", host=host, port=port),
+            RedisListQueue("scoreResponseQueue", host=host, port=port))
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# driver `serve` stage + replay flow control
+# ---------------------------------------------------------------------------
+
+def test_scoring_plane_stage_in_pipeline(ws):
+    """Artifact handoff: a Pipeline trains NB then serves the test file
+    through the ONLINE plane; the stage output is byte-identical to the
+    batch predictor job's."""
+    from avenir_tpu.pipeline.driver import Pipeline, Stage
+
+    j, churn = ws["j"], ws["churn"]
+    conf2 = JobConfig({**churn, "bayesian.model.file.path": j("nb_model")})
+    get_job("BayesianPredictor").run(conf2, j("test.csv"), j("nb_pred2"))
+    batch = read_lines(j("nb_pred2"))
+
+    p = Pipeline(j("serve_ws"), JobConfig(dict(churn)))
+    p.bind("train", j("train.csv"))
+    p.bind("test", j("test.csv"))
+    p.add(Stage("bayesianDistr", "BayesianDistribution", "train",
+                "bayes_model"))
+    p.add(Stage("serve", "ScoringPlane", "test", "scored",
+                props={"serve.models": "naiveBayes",
+                       "bayesian.model.file.path": "@bayes_model",
+                       "serve.queue.depth": "16",
+                       "serve.bucket.sizes": "1,4,16"},
+                uses=("bayes_model",)))
+    counters = p.run()
+    assert read_lines(p.path("scored")) == batch
+    serve_c = counters["serve"]
+    assert serve_c.get("Serving.naiveBayes", "requests") == len(batch)
+    assert serve_c.get("Serving.naiveBayes", "recompiles") == 0
+    # queue depth 16 << 120 rows: replay flow control never sheds
+    assert serve_c.get("Serving.naiveBayes", "shed") == 0
+    assert serve_c.get("Serving.naiveBayes", "p99_us") > 0
+
+
+# ---------------------------------------------------------------------------
+# the RL loop reports through the same schema (satellite)
+# ---------------------------------------------------------------------------
+
+def test_rl_server_shares_serving_schema():
+    from avenir_tpu.models import online_rl as orl
+    from avenir_tpu.pipeline import streaming as st
+
+    learner = orl.create_learner("intervalEstimator", ["a", "b"],
+                                 {"min.reward.distr.sample": 5}, seed=3)
+    srv = st.ReinforcementLearnerServer(
+        learner, st.QueueEventSource(st.InProcQueue()),
+        st.QueueRewardReader(st.InProcQueue()),
+        st.QueueActionWriter(st.InProcQueue()), model_name="rlLoop")
+    for i in range(20):
+        srv.events.queue.push(f"ev{i},{i}")
+    assert srv.run() == 20
+    stats = srv.stats()
+    assert set(stats) == {"rlLoop"}
+    s = stats["rlLoop"]
+    # the exact keys the scoring plane publishes (utils.metrics.serving_stats)
+    assert s["requests"] == 20 and s["batches"] == 20 and s["bucket.1"] == 20
+    assert s["latency_samples"] == 20 and s["p99_ms"] >= s["p50_ms"] >= 0.0
+
+
+def test_latency_tracker_ring():
+    from avenir_tpu.utils.metrics import LatencyTracker
+
+    tr = LatencyTracker(capacity=8)
+    assert tr.percentile(99) == 0.0
+    for v in range(100):                  # old samples age out of the ring
+        tr.record(v / 1000.0)
+    assert tr.count == 100
+    assert 0.092 <= tr.percentile(50) <= 0.099
+    snap = tr.snapshot()
+    assert snap["latency_samples"] == 100 and snap["p99_ms"] >= snap["p50_ms"]
