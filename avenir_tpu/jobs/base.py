@@ -98,8 +98,22 @@ class Job:
     name: str = ""
 
     def run(self, conf: JobConfig, input_path: str, output_path: str) -> Counters:
+        from avenir_tpu.telemetry import spans as tel
+
+        tracer = tel.configure(conf)
         counters = Counters()
-        self.execute(conf, input_path, output_path, counters)
+        # the conf fingerprint ties the span to the exact configuration
+        # that ran — the same identity checkpoint snapshots carry (GL002),
+        # so a journal and a checkpoint dir cross-reference.  Built only
+        # when tracing is on: the fingerprint sorts+hashes every property,
+        # which an untraced run must not pay per job.
+        attrs = None
+        if tracer.enabled:
+            attrs = {"conf": StreamCheckpointer.run_id_from_conf(conf),
+                     "input": input_path, "output": output_path}
+        with tracer.span(f"job.{self.name or type(self).__name__}",
+                         attrs=attrs):
+            self.execute(conf, input_path, output_path, counters)
         return counters
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
@@ -479,11 +493,42 @@ class Job:
                     ckpt.chunk_done(cur, last=nxt is None)
                     prev = nxt
 
-            return enc, consume(), lambda: box["n"]
+            return enc, Job._chunk_telemetry(consume(), counters), \
+                lambda: box["n"]
         enc, ds, _rows = self.encode_input(conf, input_path,
                                            with_labels=with_labels,
                                            need_rows=False)
         return enc, ds, lambda: ds.num_rows
+
+    @staticmethod
+    def _chunk_telemetry(chunks, counters: Counters):
+        """Per-chunk telemetry around a streamed chunk source: a
+        retroactive ``chunk`` span covering the consumer's work on each
+        chunk (model accumulate + device dispatch — emitted between
+        yields, parented to the job span the consumer holds), and the
+        generalized compile-key diff so the ``Telemetry::recompiles``
+        counter measures shape churn in BATCH streams exactly like the
+        serving batcher measures it online (a steady stream recompiles
+        once at most, for the ragged tail chunk)."""
+        import time as _time
+
+        from avenir_tpu.telemetry import spans as tel
+
+        def gen():
+            tracer = tel.tracer()
+            monitor = tel.CompileKeyMonitor(counters, scope="stream",
+                                            auto_prime=True)
+            parent = tracer.current()
+            for k, ds in enumerate(chunks):
+                monitor.observe([tel.CompileKeyMonitor.shape_key(
+                    ds.codes, ds.labels, ds.cont)])
+                t0 = _time.perf_counter()
+                yield ds
+                tracer.emit_span("chunk", _time.perf_counter() - t0,
+                                 parent=parent,
+                                 attrs={"chunk": k, "rows": ds.num_rows})
+
+        return gen()
 
     @staticmethod
     def _iter_chunks_retrying(conf: JobConfig, input_path: str,
@@ -746,6 +791,12 @@ class StreamCheckpointer:
                     self.base_rows = int(state["rows"])
                     self.start = {k: state["cursor"][k]
                                   for k in ("file", "offset", "chunk")}
+                    from avenir_tpu.telemetry import spans as tel
+
+                    tel.tracer().event(
+                        "checkpoint.restore", dir=self.directory,
+                        run=self.run_id, rows=self.base_rows,
+                        chunk=int(self.start["chunk"]))
         except Exception as e:
             # ANY construction failure (tag write, makedirs, manager
             # recovery, malformed snapshot) must be deferrable: a process
@@ -860,6 +911,11 @@ class StreamCheckpointer:
                                       "chunk": int(cursor["chunk"])},
                            "rows": total_rows,
                            "run": self.run_id})
+            from avenir_tpu.telemetry import spans as tel
+
+            tel.tracer().event("checkpoint.save", dir=self.directory,
+                               run=self.run_id, rows=total_rows,
+                               chunk=int(cursor["chunk"]))
         if self.crash_after and self._consumed >= self.crash_after:
             raise RuntimeError(
                 f"stream.fault.crash.after.chunks={self.crash_after}: "

@@ -123,6 +123,15 @@ class Pipeline:
             return group, confs
         return [first], confs[:1]
 
+    def rollup(self) -> Counters:
+        """Run-level counter rollup: the SUM of every stage's counters
+        (``merge_add`` — overwrite-merge would keep only the last stage's
+        count for any name two stages share, e.g. ``Records::Processed``)."""
+        total = Counters()
+        for stage_counters in self.counters.values():
+            total.merge_add(stage_counters)
+        return total
+
     def run(self, only: Optional[Sequence[str]] = None,
             resume: bool = False) -> Dict[str, Counters]:
         if only is None:
@@ -141,11 +150,32 @@ class Pipeline:
                         needed[prod.name] = True
                         frontier.append(prod)
             todo = [s for s in self.stages if s.name in needed]
+        from avenir_tpu.telemetry import spans as tel
+
+        tracer = tel.configure(self.conf)
+        with tracer.span("pipeline.run",
+                         attrs={"workspace": self.workspace,
+                                "stages": len(todo),
+                                "resume": bool(resume)}):
+            self._run_stages(todo, resume, tracer)
+            tracer.counters("pipeline", self.rollup())
+        return self.counters
+
+    def _run_stages(self, todo: List[Stage], resume: bool, tracer) -> None:
         i = 0
         while i < len(todo):
             stage = todo[i]
             out = self.path(stage.output)
             if resume and os.path.exists(out):
+                # a satisfied stage must still appear in the run report
+                # (and the journal): an absent entry is indistinguishable
+                # from a stage the DAG never declared.  Mark IN PLACE when
+                # the stage already has counters (a partial run resumed on
+                # the same Pipeline object) — replacing them would throw
+                # away the real counts the earlier execution collected
+                marked = self.counters.setdefault(stage.name, Counters())
+                marked.set("Pipeline", "skipped", 1)
+                tracer.event("stage.skipped", stage=stage.name, output=out)
                 i += 1
                 continue
             # stage fusion (round 7): consecutive count jobs reading the
@@ -156,16 +186,32 @@ class Pipeline:
             if len(group) > 1:
                 from avenir_tpu.pipeline import scan
 
-                self.counters.update(scan.run_fused_stages(
-                    [(s.name, s.job, self.path(s.input), self.path(s.output),
-                      conf) for s, conf in zip(group, gconfs)]))
+                with tracer.span("scan.fused",
+                                 attrs={"stages": [s.name for s in group],
+                                        "input": self.path(group[0].input)}
+                                 ) as sp:
+                    fused = scan.run_fused_stages(
+                        [(s.name, s.job, self.path(s.input),
+                          self.path(s.output), conf)
+                         for s, conf in zip(group, gconfs)])
+                    self.counters.update(fused)
+                    first = fused[group[0].name]
+                    sp.set("chunks", first.get("SharedScan", "Chunks"))
+                    sp.set("rows", first.get("Records", "Processed"))
+                    for s in group:
+                        tracer.counters(s.name, fused[s.name])
                 i += len(group)
                 continue
             conf = gconfs[0] if gconfs else self._stage_conf(stage)
-            self.counters[stage.name] = stage.run(
-                conf, self.path(stage.input), out)
+            with tracer.span(f"stage.{stage.name}",
+                             attrs={"job": (stage.job if isinstance(
+                                 stage.job, str) else getattr(
+                                     stage.job, "__name__", "callable")),
+                                    "output": out}):
+                self.counters[stage.name] = stage.run(
+                    conf, self.path(stage.input), out)
+                tracer.counters(stage.name, self.counters[stage.name])
             i += 1
-        return self.counters
 
 
 def knn_pipeline(workspace: str, conf: JobConfig, train_path: str,

@@ -270,6 +270,7 @@ class SharedScan:
     def __init__(self, mesh=None, pair_chunk: int = 256):
         self.mesh = mesh
         self.pair_chunk = pair_chunk
+        self.chunks_seen = 0              # set by run(); fused stages report it
         self._consumers: List[ScanConsumer] = []
 
     def register(self, consumer: ScanConsumer) -> ScanConsumer:
@@ -287,7 +288,6 @@ class SharedScan:
         if not self._consumers:
             raise ScanError("no consumers registered")
         from avenir_tpu.ops import pallas_hist
-        from avenir_tpu.parallel.mesh import maybe_shard_batch
 
         meta, chunks = peek_chunks(data)
         if meta.labels is None:
@@ -320,45 +320,80 @@ class SharedScan:
                 step = "sharded"
             else:
                 step = "einsum"
+        from avenir_tpu.telemetry import spans as tel
+
+        tracer = tel.tracer()
         gk = pallas_hist.g_key(f, b, c)
         acc = agg.Accumulator()
         rows = 0
-        for ds in chunks:
-            rows += ds.num_rows
-            codes, labels, cont = maybe_shard_batch(
-                self.mesh, ds.codes, ds.labels, ds.cont)
-            acc.add("class", agg.class_counts(labels, c))
-            moments_done = False
-            if step == "kernel":
-                if needs_moments:
-                    # one fused dispatch: gram + moments of the resident chunk
-                    g, cnt, s1, s2 = pallas_hist.gram_moments(
-                        codes, labels, cont, b, c)
-                    acc.add(gk, g)
-                    acc.add("cont_count", cnt)
-                    acc.add("cont_sum", s1)
-                    acc.add("cont_sumsq", s2)
-                    moments_done = True
-                else:
-                    acc.add(gk, pallas_hist.cooc_counts(codes, labels, b, c))
-            elif step == "sharded":
-                acc.add(gk, sharded(codes, labels))
-            elif step == "einsum":
-                acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
-                for s in range(0, len(pair_index), self.pair_chunk):
-                    sl = pair_index[s:s + self.pair_chunk]
-                    # SharedScan accumulators live only for one fused scan
-                    # (checkpointed stages never fuse — stage_fusable), so
-                    # no restore path exists for a stale key to corrupt;
-                    # keys mirror models/mutual_info.py's gated family
-                    # graftlint: disable=GL002
-                    acc.add(f"pcc{s}", agg.pair_class_counts(
-                        codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b))
-            if needs_moments and not moments_done:
-                cnt, s1, s2 = agg.class_moments(cont, labels, c)
+        self.chunks_seen = 0
+        with tracer.span("scan", attrs={
+                "consumers": [x.name for x in self._consumers],
+                "path": step or "moments"}) as scan_span:
+            for ds in chunks:
+                with tracer.span("scan.chunk",
+                                 attrs={"chunk": self.chunks_seen,
+                                        "rows": ds.num_rows}):
+                    # host accumulation inside fetches every device result,
+                    # so the chunk span's close is naturally synced.
+                    # Recompile accounting lives with the chunk SOURCE
+                    # (jobs' _chunk_telemetry) — a second monitor here
+                    # would double-count the same stream
+                    self._scan_chunk(ds, acc, step, sharded, gk, b, c,
+                                     pair_index, needs_moments)
+                rows += ds.num_rows
+                self.chunks_seen += 1
+            scan_span.set("chunks", self.chunks_seen)
+            scan_span.set("rows", rows)
+        return self._finalize(acc, meta, rows, f, b, c, gk, pair_index,
+                              needs_counts, needs_moments)
+
+    def _scan_chunk(self, ds, acc, step, sharded, gk, b, c, pair_index,
+                    needs_moments) -> None:
+        """One chunk's device pass + 64-bit host accumulation (the body of
+        :meth:`run`'s stream loop, factored out for per-chunk spans)."""
+        from avenir_tpu.ops import pallas_hist
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+
+        codes, labels, cont = maybe_shard_batch(
+            self.mesh, ds.codes, ds.labels, ds.cont)
+        acc.add("class", agg.class_counts(labels, c))
+        moments_done = False
+        if step == "kernel":
+            if needs_moments:
+                # one fused dispatch: gram + moments of the resident chunk
+                g, cnt, s1, s2 = pallas_hist.gram_moments(
+                    codes, labels, cont, b, c)
+                acc.add(gk, g)
                 acc.add("cont_count", cnt)
                 acc.add("cont_sum", s1)
                 acc.add("cont_sumsq", s2)
+                moments_done = True
+            else:
+                acc.add(gk, pallas_hist.cooc_counts(codes, labels, b, c))
+        elif step == "sharded":
+            acc.add(gk, sharded(codes, labels))
+        elif step == "einsum":
+            acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
+            for s in range(0, len(pair_index), self.pair_chunk):
+                sl = pair_index[s:s + self.pair_chunk]
+                # SharedScan accumulators live only for one fused scan
+                # (checkpointed stages never fuse — stage_fusable), so
+                # no restore path exists for a stale key to corrupt;
+                # keys mirror models/mutual_info.py's gated family
+                # graftlint: disable=GL002
+                acc.add(f"pcc{s}", agg.pair_class_counts(
+                    codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b))
+        if needs_moments and not moments_done:
+            cnt, s1, s2 = agg.class_moments(cont, labels, c)
+            acc.add("cont_count", cnt)
+            acc.add("cont_sum", s1)
+            acc.add("cont_sumsq", s2)
+
+    def _finalize(self, acc, meta, rows, f, b, c, gk, pair_index,
+                  needs_counts, needs_moments) -> Dict[str, Any]:
+        from avenir_tpu.ops import pallas_hist
+
         fbc = pcc = None
         if needs_counts and gk in acc:
             fbc, pcc = pallas_hist.counts_from_cooc(
@@ -455,6 +490,9 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
     schema = Job.load_schema(first_conf)
     mesh = Job.auto_mesh(first_conf)
     counters = {name: Counters() for name, *_ in stages}
+    # the first stage's Counters carries the stream-side telemetry
+    # (Telemetry::recompiles via _chunk_telemetry) — one scan, one
+    # accounting home
     enc, data, rows_fn = job_obj.encoded_data_source(
         first_conf, in_path, counters[stages[0][0]], mesh=mesh)
     engine = SharedScan(mesh=mesh)
@@ -499,4 +537,5 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
         counters[name].set("Records", "Processed", rows)
         counters[name].set("SharedScan", "FusedStages", len(stages))
         counters[name].set("SharedScan", "Scans", 1)
+        counters[name].set("SharedScan", "Chunks", engine.chunks_seen)
     return counters

@@ -581,6 +581,10 @@ class ServerSupervisor:
             self._server = self.server_factory()
             if self.last_checkpoint is not None:
                 self._server.restore(self.last_checkpoint)
+                from avenir_tpu.telemetry import spans as tel
+
+                tel.tracer().event("checkpoint.restore", scope="rl",
+                                   events=self.events_processed)
         return self._server
 
     def run(self, max_events: Optional[int] = None) -> int:
@@ -601,10 +605,19 @@ class ServerSupervisor:
                     self.restarts = 0      # stable again: refill the budget
                 if self.events_processed % self.checkpoint_interval == 0:
                     self.last_checkpoint = srv.checkpoint()
-            except Exception:
+                    from avenir_tpu.telemetry import spans as tel
+
+                    tel.tracer().event("checkpoint.save", scope="rl",
+                                       events=self.events_processed)
+            except Exception as exc:
                 self.restarts += 1
                 self._events_since_crash = 0
                 self._server = None        # next access builds + restores
+                from avenir_tpu.telemetry import spans as tel
+
+                tel.tracer().event("server.restart", scope="rl",
+                                   restarts=self.restarts,
+                                   error=type(exc).__name__)
                 if self.restarts > self.max_restarts:
                     raise
         # final checkpoint so a subsequent supervisor resumes precisely

@@ -9,6 +9,7 @@ MXU fed instead of alternating parse → transfer → compute.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -59,6 +60,7 @@ class DeviceFeeder:
     def __init__(self, source: Iterable[T], depth: int = 2,
                  stage: Optional[Callable[[T], T]] = None,
                  device: Optional[jax.Device] = None):
+        source, stage = self._traced_pipeline(source, stage, device)
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._err_box: dict = {}
         self._stop = threading.Event()
@@ -79,6 +81,68 @@ class DeviceFeeder:
         # reference self or it would keep the feeder alive forever
         self._finalizer = weakref.finalize(self, self._stop.set)
         self._thread.start()
+
+    @staticmethod
+    def _traced_pipeline(source, stage, device):
+        """Wrap (source, stage) with a retroactive ``feeder.stage`` span
+        per item when tracing is on.  The worker thread never holds the
+        consumer's contextvar, so the parent is captured HERE — on the
+        constructing thread, inside whatever job/stage span is current.
+
+        Honest wall times (the spans.py contract): the span covers the
+        SOURCE PULL (where the lazy chunk readers actually parse+encode)
+        plus the stage call, and the staged arrays are host-synced before
+        the close — ``device_put`` dispatch is async, so an unsynced span
+        would time the enqueue, not the upload, and the slowest-path
+        marker would point at the wrong seam.  Pull and stage run
+        sequentially on the one worker thread, so the shared time box is
+        race-free.  With tracing off this returns the inputs untouched —
+        no wrapper frame on the hot path."""
+        from avenir_tpu.telemetry import spans as tel
+
+        tracer = tel.tracer()
+        if not tracer.enabled:
+            return source, stage
+        parent = tracer.current()
+        inner = stage or (lambda item, _d=device:
+                          DeviceFeeder._default_stage(item, _d))
+        box = {"t0": None, "chunk": itertools.count()}
+
+        def timed_source():
+            it = iter(source)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                box["t0"] = t0
+                yield item
+
+        def traced_stage(item):
+            out = inner(item)
+            DeviceFeeder._sync_staged(out)
+            t0 = box["t0"] if box["t0"] is not None else time.perf_counter()
+            box["t0"] = None
+            tracer.emit_span("feeder.stage", time.perf_counter() - t0,
+                             parent=parent,
+                             attrs={"chunk": next(box["chunk"])})
+            return out
+
+        return timed_source(), traced_stage
+
+    @staticmethod
+    def _sync_staged(out) -> None:
+        """Host-sync a staged item's device arrays (EncodedDataset-shaped
+        objects, tuples of them, or plain array pytrees)."""
+        from avenir_tpu.utils.profiling import device_sync
+
+        for item in (out if isinstance(out, (tuple, list)) else (out,)):
+            if hasattr(item, "codes"):          # EncodedDataset-shaped
+                device_sync([x for x in (item.codes, item.labels, item.cont)
+                             if x is not None])
+            else:
+                device_sync(item)
 
     @staticmethod
     def _default_stage(item, device):

@@ -43,13 +43,20 @@ from avenir_tpu.serving.errors import (
     ShedError,
 )
 from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.telemetry import spans as tel
 from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
 
 
 class PendingRequest:
-    """One in-flight request; ``wait`` blocks until scored (or failed)."""
+    """One in-flight request; ``wait`` blocks until scored (or failed).
 
-    __slots__ = ("model", "line", "enqueued", "result", "error", "_done")
+    ``trace_ctx`` captures the submitter's span (None with tracing off):
+    the dispatch thread can't see the submitting context, so the request's
+    span is emitted retroactively with this parent — how a serving request
+    joins the pipeline trace through the ScoringPlane stage."""
+
+    __slots__ = ("model", "line", "enqueued", "result", "error", "_done",
+                 "trace_ctx")
 
     def __init__(self, model: str, line: str):
         self.model = model
@@ -58,6 +65,7 @@ class PendingRequest:
         self.result: Optional[str] = None
         self.error: Optional[ServingError] = None
         self._done = threading.Event()
+        self.trace_ctx = tel.tracer().current()
 
     def finish(self, result: Optional[str] = None,
                error: Optional[ServingError] = None) -> None:
@@ -96,8 +104,13 @@ class BucketedMicrobatcher:
             name: LatencyTracker() for name in registry.names()}
         self._queues: Dict[str, Deque[PendingRequest]] = {
             name: deque() for name in registry.names()}
-        self._known_keys: Dict[str, set] = {name: set()
-                                            for name in registry.names()}
+        # recompile accounting: the shared compile-key diff (telemetry,
+        # generalized out of this file in round 10) — warmup primes it,
+        # any fresh key afterwards counts under Serving.<name>::recompiles
+        self._monitors: Dict[str, tel.CompileKeyMonitor] = {
+            name: tel.CompileKeyMonitor(self.counters,
+                                        group=f"Serving.{name}", scope=name)
+            for name in registry.names()}
         self._cond = threading.Condition()
         self._stop = False
         if warmup:
@@ -126,7 +139,7 @@ class BucketedMicrobatcher:
         as recompiles later."""
         warmed = self.registry.warmup(self.buckets)
         for name, entry in self.registry.items():
-            self._known_keys[name] |= set(entry.compile_keys)
+            self._monitors[name].prime(entry.compile_keys)
         return warmed
 
     # -- submission (any thread) ---------------------------------------------
@@ -250,24 +263,34 @@ class BucketedMicrobatcher:
     def _finish_scored(self, entry, group: str, model: str,
                        live: List[PendingRequest], outs: List[str],
                        bucket: int) -> None:
-        fresh = entry.compile_keys - self._known_keys[model]
-        if fresh:
-            # a shape outside the warmed set means this batch paid a compile
-            # on the hot path — the invariant violation the counter exposes
-            self._known_keys[model] |= fresh
-            self.counters.increment(group, "recompiles", len(fresh))
+        # a shape outside the warmed set means this batch paid a compile
+        # on the hot path — the invariant violation the counter exposes
+        self._monitors[model].observe(entry.compile_keys)
         done = time.monotonic()
+        tracer = tel.tracer()
         tracker = self.latency[model]
         for req, out in zip(live, outs):
             req.finish(result=out)
-            tracker.record(done - req.enqueued)
+            wait_s = done - req.enqueued
+            tracker.record(wait_s)
+            if tracer.enabled:
+                tracer.emit_span("serve.request", wait_s,
+                                 parent=req.trace_ctx,
+                                 attrs={"model": model, "bucket": bucket})
         self.counters.increment(group, "requests", len(live))
         self.counters.increment(group, "batches")
         self.counters.increment(group, f"bucket.{bucket}")
+        if tracer.enabled:
+            tracer.gauge(f"serve.queue.{model}", len(self._queues[model]))
 
     # -- observability / shutdown --------------------------------------------
     def stats(self) -> Dict[str, dict]:
         return serving_stats(self.counters, self.latency)
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Per-model pending-queue depth — the ``/metrics`` gauges."""
+        with self._cond:
+            return {name: len(q) for name, q in self._queues.items()}
 
     def close(self) -> None:
         """Flush every pending request, then stop the dispatcher."""

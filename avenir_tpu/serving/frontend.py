@@ -66,15 +66,36 @@ class ScoreHTTPServer:
                 pass
 
             def _send(self, status: int, payload: dict) -> None:
-                body = json.dumps(payload).encode()
+                self._send_text(status, json.dumps(payload),
+                                "application/json")
+
+            def _send_text(self, status: int, text: str,
+                           content_type: str) -> None:
+                body = text.encode()
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                if self.path == "/metrics":
+                    # Prometheus text exposition of the same counters the
+                    # journal snapshots and /stats reports as JSON —
+                    # scrape-ready (telemetry/export.py)
+                    from avenir_tpu.telemetry.export import prometheus_text
+
+                    gauges = {f"serve.queue.{name}": float(depth)
+                              for name, depth
+                              in outer.batcher.queue_depths().items()}
+                    gauges["uptime.sec"] = time.monotonic() - outer.started
+                    self._send_text(
+                        200,
+                        prometheus_text(counters=outer.batcher.counters,
+                                        latency=outer.batcher.latency,
+                                        gauges=gauges),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path == "/healthz":
                     self._send(200, {
                         "status": "ok",
                         "models": outer.batcher.registry.names(),

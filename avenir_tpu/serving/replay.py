@@ -48,9 +48,15 @@ class ScoringPlane(Job):
             model = names[0]
         batcher = BucketedMicrobatcher.from_conf(registry, conf)
         lines = read_lines(input_path)
+        max_inflight = max(batcher.queue_depth - 1, 1)
+        from avenir_tpu.telemetry import spans as tel
+
+        # every submit below runs inside this job's span, so each request's
+        # PendingRequest captures it and the serving spans join THIS trace
+        tel.tracer().event("serve.replay", model=model, rows=len(lines),
+                           max_inflight=max_inflight)
         outs = [None] * len(lines)
         wait_s = batcher.request_timeout_s + 30.0
-        max_inflight = max(batcher.queue_depth - 1, 1)
         pending = deque()
         try:
             for i, line in enumerate(lines):

@@ -1,0 +1,37 @@
+"""GraftTrace — run-scoped tracing, the event journal, and metrics export.
+
+Three pieces (docs/observability.md ties them together with the existing
+profiling/counters machinery):
+
+- ``spans``: a process-wide :class:`Tracer` (no-op until ``trace.on``)
+  handing out contextvar-propagated :class:`Span`\\ s, plus the
+  generalized :class:`CompileKeyMonitor` recompile detector;
+- ``journal``: the append-only JSONL run journal (single-writer,
+  rotation-bounded, torn-tail tolerant) every span and event lands in;
+- ``export``: Prometheus text rendering of Counters + latency trackers +
+  gauges, served from the scoring plane's ``/metrics`` route.
+
+``python -m avenir_tpu.telemetry <journal>`` renders a run's span tree.
+"""
+
+from avenir_tpu.telemetry.journal import Journal, latest_journal, read_events
+from avenir_tpu.telemetry.spans import (
+    NOOP_SPAN,
+    CompileKeyMonitor,
+    Span,
+    Tracer,
+    configure,
+    tracer,
+)
+
+__all__ = [
+    "CompileKeyMonitor",
+    "Journal",
+    "NOOP_SPAN",
+    "Span",
+    "Tracer",
+    "configure",
+    "latest_journal",
+    "read_events",
+    "tracer",
+]

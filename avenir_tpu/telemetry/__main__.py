@@ -1,0 +1,174 @@
+"""GraftTrace journal viewer — ``python -m avenir_tpu.telemetry <journal>``.
+
+Renders a run journal (``telemetry/journal.py`` JSONL) as a per-trace span
+tree: one line per span with its wall duration, the slowest root→leaf path
+highlighted (``◀`` — the first place to look in a slow run), still-open
+spans flagged (``OPEN`` — the first place to look in a *wedged* run),
+counter deltas between successive snapshots of the same scope, and a
+one-line tally of the free events (checkpoints, recompiles, gauges,
+canaries).  Stdlib-only — usable on a machine with no JAX installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from avenir_tpu.telemetry.journal import read_events
+
+
+class SpanNode:
+    def __init__(self, span_id: str, name: str, parent: Optional[str],
+                 attrs: dict, ts: float):
+        self.span_id = span_id
+        self.name = name
+        self.parent = parent
+        self.attrs = dict(attrs or {})
+        self.ts = ts
+        self.dur_ms: Optional[float] = None     # None = never closed
+        self.status = "open"
+        self.children: List["SpanNode"] = []
+
+
+def build_traces(events: List[dict]) -> Dict[str, List[SpanNode]]:
+    """trace id → roots (in open order), children attached."""
+    nodes: Dict[str, SpanNode] = {}
+    traces: Dict[str, List[SpanNode]] = {}
+    for event in events:
+        ev = event.get("ev")
+        if ev == "span.open":
+            node = SpanNode(event.get("span", "?"), event.get("name", "?"),
+                            event.get("parent"), event.get("attrs", {}),
+                            event.get("at", event.get("ts", 0.0)))
+            nodes[node.span_id] = node
+            parent = nodes.get(node.parent) if node.parent else None
+            if parent is not None:
+                parent.children.append(node)
+            else:
+                traces.setdefault(event.get("trace", "?"), []).append(node)
+        elif ev == "span.close":
+            node = nodes.get(event.get("span", ""))
+            if node is not None:
+                node.dur_ms = event.get("dur_ms")
+                node.status = event.get("status", "ok")
+                node.attrs.update(event.get("attrs", {}))
+    return traces
+
+
+def slowest_path(root: SpanNode) -> set:
+    """Span ids on the root's max-duration descent — open spans sort as
+    infinitely slow (a wedged child IS the slow path)."""
+    marked = set()
+    node = root
+    while node is not None:
+        marked.add(node.span_id)
+        node = max(node.children, key=lambda ch: (
+            ch.dur_ms is None, ch.dur_ms or 0.0), default=None)
+    return marked
+
+_INTERESTING_ATTRS = ("job", "stages", "chunks", "rows", "bucket", "model")
+
+
+def _render_node(node: SpanNode, prefix: str, is_last: bool, hot: set,
+                 out: List[str]) -> None:
+    connector = "" if not prefix and is_last is None else (
+        "└─ " if is_last else "├─ ")
+    dur = ("OPEN" if node.dur_ms is None else f"{node.dur_ms:.1f} ms")
+    extra = " ".join(f"{k}={node.attrs[k]}" for k in _INTERESTING_ATTRS
+                     if k in node.attrs)
+    mark = "  ◀" if node.span_id in hot else ""
+    bad = f"  [{node.status}]" if node.status not in ("ok", "open") else ""
+    label = f"{prefix}{connector}{node.name}"
+    pad = max(44 - len(label), 1)
+    out.append(f"{label}{' ' * pad}{dur:>10}{mark}{bad}"
+               + (f"  ({extra})" if extra else ""))
+    child_prefix = prefix + ("" if not prefix and is_last is None else
+                             ("   " if is_last else "│  "))
+    for i, child in enumerate(node.children):
+        _render_node(child, child_prefix, i == len(node.children) - 1,
+                     hot, out)
+
+
+def counter_deltas(events: List[dict]) -> List[str]:
+    """Per-scope deltas between successive counter snapshots (the first
+    snapshot of a scope reads as a delta from zero)."""
+    prev: Dict[str, Dict[str, Dict[str, int]]] = {}
+    out: List[str] = []
+    for event in events:
+        if event.get("ev") != "counters":
+            continue
+        scope = event.get("scope", "?")
+        groups = event.get("groups", {})
+        before = prev.get(scope, {})
+        for group in sorted(groups):
+            for name in sorted(groups[group]):
+                delta = groups[group][name] - before.get(group, {}).get(
+                    name, 0)
+                if delta:
+                    out.append(f"  [{scope}] {group}::{name} +{delta}")
+        prev[scope] = groups
+    return out
+
+
+def render(events: List[dict], trace_filter: Optional[str] = None
+           ) -> List[str]:
+    traces = build_traces(events)
+    out: List[str] = []
+    for trace_id, roots in traces.items():
+        if trace_filter and trace_id != trace_filter:
+            continue
+        for root in roots:
+            total = ("OPEN" if root.dur_ms is None
+                     else f"{root.dur_ms:.1f} ms")
+            out.append(f"trace {trace_id}  ({root.name}, {total})")
+            _render_node(root, "", None, slowest_path(root), out)
+            out.append("")
+    deltas = counter_deltas(events)
+    if deltas:
+        out.append("counter deltas:")
+        out.extend(deltas)
+        out.append("")
+    tally: Dict[str, int] = {}
+    for event in events:
+        ev = event.get("ev", "?")
+        if ev not in ("span.open", "span.close", "counters"):
+            tally[ev] = tally.get(ev, 0) + 1
+    if tally:
+        out.append("events: " + " · ".join(
+            f"{n} {ev}" for ev, n in sorted(tally.items())))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m avenir_tpu.telemetry",
+        description="Render a GraftTrace run journal as a span tree")
+    ap.add_argument("journal", help="run-*.jsonl journal file")
+    ap.add_argument("--trace", default=None,
+                    help="render only this trace id")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="dump the decoded events as a JSON array instead")
+    args = ap.parse_args(argv)
+    try:
+        events = read_events(args.journal)
+    except OSError as exc:
+        print(f"cannot read journal: {exc}", file=sys.stderr)
+        return 2
+    try:
+        if args.as_json:
+            print(json.dumps(events))
+            return 0
+        if not events:
+            print("journal carries no decodable events", file=sys.stderr)
+            return 1
+        for line in render(events, trace_filter=args.trace):
+            print(line)
+    except BrokenPipeError:                # | head closed the pipe: fine
+        sys.stderr.close()                 # suppress the shutdown warning
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

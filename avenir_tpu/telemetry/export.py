@@ -1,0 +1,77 @@
+"""Prometheus text-format rendering of the in-process observability state.
+
+The one scrapeable surface over the three metric shapes the framework
+already has: :class:`~avenir_tpu.utils.metrics.Counters` (named counter
+groups — the Hadoop-counter stand-in), per-model
+:class:`~avenir_tpu.utils.metrics.LatencyTracker` percentiles, and
+point-in-time gauges (queue depths).  Served from the scoring-plane
+frontend's ``/metrics`` route (``serving/frontend.py``) in the Prometheus
+text exposition format (version 0.0.4), so a stock Prometheus scrape —
+or ``curl`` — reads the same counters the job layer prints and the
+journal snapshots.
+
+Counter groups/names keep their in-tree dotted spelling as label values
+(``group="Serving.naiveBayes", name="bucket.8"``) rather than being
+mangled into metric names — the cardinality lives in labels, and the
+label values round-trip exactly to what ``Counters.as_dict`` reports.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional
+
+
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_counters(counters, lines: List[str]) -> None:
+    lines.append("# HELP avenir_counter_total Named job/serving counters "
+                 "(Counters groups).")
+    lines.append("# TYPE avenir_counter_total counter")
+    groups = counters.as_dict()
+    for group in sorted(groups):
+        for name in sorted(groups[group]):
+            lines.append(
+                f'avenir_counter_total{{group="{_escape(group)}",'
+                f'name="{_escape(name)}"}} {groups[group][name]}')
+
+
+def render_latency(latency: Mapping[str, object], lines: List[str]) -> None:
+    lines.append("# HELP avenir_latency_seconds Request latency over the "
+                 "retained ring window.")
+    lines.append("# TYPE avenir_latency_seconds summary")
+    for model in sorted(latency):
+        tracker = latency[model]
+        for q in (50.0, 99.0):
+            lines.append(
+                f'avenir_latency_seconds{{model="{_escape(model)}",'
+                f'quantile="{q / 100.0:g}"}} {tracker.percentile(q):.6g}')
+        lines.append(
+            f'avenir_latency_seconds_count{{model="{_escape(model)}"}} '
+            f"{tracker.count}")
+
+
+def render_gauges(gauges: Mapping[str, float], lines: List[str]) -> None:
+    lines.append("# HELP avenir_gauge Point-in-time gauges (queue depths, "
+                 "uptime).")
+    lines.append("# TYPE avenir_gauge gauge")
+    for name in sorted(gauges):
+        lines.append(
+            f'avenir_gauge{{name="{_escape(name)}"}} {gauges[name]:g}')
+
+
+def prometheus_text(counters=None,
+                    latency: Optional[Mapping[str, object]] = None,
+                    gauges: Optional[Mapping[str, float]] = None) -> str:
+    """The full exposition document; any section may be omitted."""
+    lines: List[str] = []
+    if counters is not None:
+        render_counters(counters, lines)
+    if latency:
+        render_latency(latency, lines)
+    if gauges:
+        render_gauges(gauges, lines)
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,140 @@
+"""Append-only JSONL event journal — the durable half of GraftTrace.
+
+One journal file per traced run.  Every event is one JSON object on one
+line (``{"ev": ..., "ts": ..., ...}``), written append-only and flushed
+per event so a wedged or killed run leaves a readable timeline up to the
+moment it died — the diagnostic the Hadoop job UI gave the reference and
+this port lacked (ISSUE 5).  Three disciplines:
+
+- **single writer**: the journal takes the existing advisory
+  :class:`~avenir_tpu.utils.locking.FileLock` on open and holds it for its
+  lifetime, so a second process appending to the same file is *detected*
+  (LockHeldError) instead of interleaving torn lines; in multi-process
+  runs only process 0 opens a journal at all
+  (``telemetry.spans.configure``).
+- **rotation-bounded**: when the file would exceed
+  ``telemetry.journal.max.mb`` the current file rotates to ``<path>.1``
+  (replacing the previous rotation), so a long-lived serving process
+  cannot grow the journal without bound.
+- **torn-tail tolerance**: a crash mid-``write`` leaves at most one
+  partial final line; :func:`read_events` skips it (and any other
+  undecodable line) so every event that was fully written stays
+  readable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from avenir_tpu.utils.locking import FileLock
+
+
+class Journal:
+    """Single-writer append-only JSONL sink.
+
+    ``emit`` is thread-safe (serving dispatch threads, feeder workers and
+    the pipeline thread all write to the one run journal); cross-process
+    exclusion is the FileLock's job.
+    """
+
+    def __init__(self, path: str, max_bytes: int = 64 << 20,
+                 lock_timeout_s: float = 0.0):
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        self.path = path
+        self.max_bytes = max(int(max_bytes), 1 << 12)
+        self._mutex = threading.Lock()
+        # held for the journal's lifetime: a concurrent writer raises
+        # LockHeldError here instead of silently interleaving lines
+        self._flock = FileLock(path, timeout_s=lock_timeout_s).acquire()
+        self._fh = open(path, "a", encoding="utf-8")
+        self.events_written = 0
+
+    def emit(self, ev: str, **fields) -> None:
+        """Append one event; ``ev`` is the event type, ``ts`` is stamped
+        here.  Non-serializable field values degrade to ``repr`` rather
+        than losing the event."""
+        record: Dict[str, object] = {"ev": ev, "ts": round(time.time(), 6)}
+        record.update(fields)
+        try:
+            line = json.dumps(record, separators=(",", ":"))
+        except (TypeError, ValueError):
+            line = json.dumps({k: (v if isinstance(
+                v, (str, int, float, bool, type(None))) else repr(v))
+                for k, v in record.items()}, separators=(",", ":"))
+        with self._mutex:
+            if self._fh.closed:
+                return                     # emit after close: drop, not crash
+            if self._fh.tell() + len(line) + 1 > self.max_bytes:
+                self._rotate()
+            self._fh.write(line)
+            self._fh.write("\n")
+            self._fh.flush()
+            self.events_written += 1
+
+    def _rotate(self) -> None:
+        """Roll the full file to ``<path>.1`` (replacing the previous
+        rotation) and start fresh — append-only within a file, bounded
+        across the pair."""
+        self._fh.close()
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        with self._mutex:
+            if not self._fh.closed:
+                self._fh.flush()
+                self._fh.close()
+            self._flock.release()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def iter_events(path: str) -> Iterator[dict]:
+    """Yield every decodable event of a journal file in write order.
+
+    A truncated final line (crash mid-write) or any other undecodable
+    line is skipped — the journal contract is that every *fully written*
+    event survives, not that the file as a whole is one valid document."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except ValueError:
+                continue                  # torn tail / corrupt line
+            if isinstance(event, dict):
+                yield event
+
+
+def read_events(path: str, with_rotated: bool = True) -> List[dict]:
+    """All events of a journal (rotated ``<path>.1`` first when present,
+    so the list stays in write order across a rotation)."""
+    out: List[dict] = []
+    if with_rotated and os.path.exists(path + ".1"):
+        out.extend(iter_events(path + ".1"))
+    out.extend(iter_events(path))
+    return out
+
+
+def latest_journal(directory: str) -> Optional[str]:
+    """The most recently modified ``run-*.jsonl`` under ``directory``."""
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.startswith("run-") and n.endswith(".jsonl")]
+    except OSError:
+        return None
+    if not names:
+        return None
+    return os.path.join(directory, max(
+        names, key=lambda n: os.path.getmtime(os.path.join(directory, n))))

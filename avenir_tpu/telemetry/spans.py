@@ -1,0 +1,347 @@
+"""Run-scoped structured tracing — spans, the process tracer, and the
+generalized recompile monitor.
+
+One trace id per run, one span per unit of work: ``Pipeline.run`` opens a
+root span, each stage/job/chunk/dispatch/serving-request opens a child, and
+every open/close is journaled (``telemetry/journal.py``) so a slow or
+wedged run reads as ONE tree (``python -m avenir_tpu.telemetry <journal>``)
+instead of five unrelated artifacts.  Design constraints:
+
+- **off by default is free**: the process :class:`Tracer` is a no-op until
+  ``trace.on`` enables it — ``span()`` then returns a shared inert span
+  object, so the hot paths pay one attribute check and no allocation
+  (asserted against the published nb_mi band; measured in
+  ``benchmarks/telemetry_overhead.py``).
+- **contextvar propagation**: the current span rides a ``contextvars``
+  variable, so nesting needs no plumbing and concurrent threads never
+  share a current span.  Work that *crosses* threads (DeviceFeeder
+  workers, the serving dispatch thread) captures the submitting context
+  explicitly and emits its spans retroactively (:meth:`Tracer.emit_span`)
+  with that parent — the seam that lets a serving request join the
+  pipeline trace through the ScoringPlane stage.
+- **honest wall times**: JAX dispatch is async, so a span measuring
+  device work registers its output via :meth:`Span.block_on` and the
+  close performs the host fetch through the existing
+  ``profiling.device_sync`` discipline (``jax.block_until_ready`` is a
+  no-op on some transports — BASELINE.md "Timing methodology").
+- **single-writer journal**: in multi-process runs only process 0 gets an
+  enabled tracer (``configure``), matching the part-file writer protocol.
+
+:class:`CompileKeyMonitor` generalizes the serving batcher's compile-key
+diff (round 9) so *batch* chunk loops get the same measured ``recompiles``
+counter: feed each dispatch's shape/compile keys through ``observe`` and
+any key outside the primed set increments the counter and journals a
+``recompile`` event.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, Iterator, Optional
+
+from avenir_tpu.telemetry.journal import Journal
+
+_CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "avenir_tpu_current_span", default=None)
+
+
+class Span:
+    """One unit of work: identity (trace/span/parent ids), a name, attrs,
+    and wall times.  Mutate attrs via :meth:`set`; register async device
+    output via :meth:`block_on` so the close time is honest."""
+
+    __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
+                 "attrs", "ts", "_t0", "dur_ms", "status", "_pending")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str,
+                 attrs: Optional[Dict[str, Any]]):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+        self.ts = time.time()
+        self._t0 = time.perf_counter()
+        self.dur_ms: Optional[float] = None
+        self.status = "ok"
+        self._pending = None
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def set(self, key: str, value: Any) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def block_on(self, value):
+        """Register the span's device output; host-synced at close so the
+        recorded duration covers the compute, not just the dispatch."""
+        self._pending = value
+        return value
+
+    def event(self, ev: str, **fields) -> None:
+        """Journal an event carrying this span's identity."""
+        self.tracer._journal_emit(ev, trace=self.trace_id,
+                                  span=self.span_id, **fields)
+
+    def _close(self) -> None:
+        if self._pending is not None:
+            from avenir_tpu.utils.profiling import device_sync
+
+            device_sync(self._pending)
+            self._pending = None
+        self.dur_ms = (time.perf_counter() - self._t0) * 1e3
+
+
+class _NoopSpan:
+    """The shared inert span handed out while tracing is off — every
+    operation is a no-op, so instrumented code needs no ``if`` guards."""
+
+    __slots__ = ()
+    enabled = False
+    trace_id = span_id = parent_id = None
+    attrs: Dict[str, Any] = {}
+
+    def set(self, key, value):
+        return self
+
+    def block_on(self, value):
+        return value
+
+    def event(self, ev, **fields):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def _new_id(prefix: str) -> str:
+    return prefix + os.urandom(6).hex()
+
+
+class Tracer:
+    """Process-wide span factory + journal front.  Disabled (free) until
+    :meth:`enable`; ``configure(conf)`` wires it from ``trace.*`` keys."""
+
+    def __init__(self):
+        self.enabled = False
+        self.journal: Optional[Journal] = None
+        self._seq = itertools.count(1)           # thread-safe in CPython
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def enable(self, journal_dir: Optional[str] = None,
+               max_bytes: int = 64 << 20) -> "Tracer":
+        """Turn tracing on; with ``journal_dir``, open the run journal
+        ``run-<id>.jsonl`` there (single-writer, rotation-bounded)."""
+        with self._lock:
+            if self.enabled:
+                return self
+            if journal_dir:
+                path = os.path.join(journal_dir,
+                                    f"run-{_new_id('')}.jsonl")
+                self.journal = Journal(path, max_bytes=max_bytes)
+            self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        """Turn tracing off and close the journal (tests, run teardown)."""
+        with self._lock:
+            self.enabled = False
+            if self.journal is not None:
+                self.journal.close()
+                self.journal = None
+
+    @property
+    def journal_path(self) -> Optional[str]:
+        return self.journal.path if self.journal is not None else None
+
+    # -- span factory --------------------------------------------------------
+    def current(self) -> Optional[Span]:
+        """The context's live span (cross-thread parent capture), or None
+        when tracing is off or no span is open."""
+        return _CURRENT.get() if self.enabled else None
+
+    def span(self, name: str, attrs: Optional[Dict[str, Any]] = None,
+             parent: Optional[Span] = None):
+        """Open a child of the context's current span (or of ``parent``
+        when crossing a thread); a span with no parent roots a new trace.
+        Disabled: returns the shared NOOP span directly — one attribute
+        check, no generator frame, no allocation (the off-is-free
+        contract; benchmarks/telemetry_overhead.py)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return self._live_span(name, attrs, parent)
+
+    @contextlib.contextmanager
+    def _live_span(self, name: str, attrs: Optional[Dict[str, Any]],
+                   parent: Optional[Span]) -> Iterator[Span]:
+        up = parent if parent is not None else _CURRENT.get()
+        trace_id = up.trace_id if up is not None else _new_id("t")
+        sp = Span(self, trace_id, f"s{next(self._seq)}",
+                  up.span_id if up is not None else None, name, attrs)
+        token = _CURRENT.set(sp)
+        self._journal_emit("span.open", trace=sp.trace_id, span=sp.span_id,
+                           parent=sp.parent_id, name=sp.name,
+                           attrs=sp.attrs)
+        try:
+            yield sp
+        except BaseException as exc:
+            sp.status = f"error:{type(exc).__name__}"
+            raise
+        finally:
+            _CURRENT.reset(token)
+            sp._close()
+            self._journal_emit("span.close", trace=sp.trace_id,
+                               span=sp.span_id, name=sp.name,
+                               dur_ms=round(sp.dur_ms, 3),
+                               status=sp.status, attrs=sp.attrs)
+
+    def emit_span(self, name: str, dur_s: float,
+                  parent: Optional[Span] = None,
+                  attrs: Optional[Dict[str, Any]] = None,
+                  status: str = "ok") -> None:
+        """Retroactively journal a completed span — the cross-thread form
+        (feeder workers, the serving dispatcher) where the work finished
+        on a thread that never held the submitting context."""
+        if not self.enabled:
+            return
+        trace_id = parent.trace_id if parent is not None else _new_id("t")
+        span_id = f"s{next(self._seq)}"
+        ts = time.time()
+        self._journal_emit("span.open", trace=trace_id, span=span_id,
+                           parent=parent.span_id if parent else None,
+                           name=name, attrs=dict(attrs or {}), ts=ts - dur_s)
+        self._journal_emit("span.close", trace=trace_id, span=span_id,
+                           name=name, dur_ms=round(dur_s * 1e3, 3),
+                           status=status, attrs=dict(attrs or {}), ts=ts)
+
+    # -- journal shorthands --------------------------------------------------
+    def _journal_emit(self, ev: str, **fields) -> None:
+        if self.journal is not None:
+            ts = fields.pop("ts", None)
+            if ts is not None:
+                # retroactive events carry their own timestamp
+                fields["at"] = round(ts, 6)
+            self.journal.emit(ev, **fields)
+
+    def event(self, ev: str, **fields) -> None:
+        """Journal a free event stamped with the current span's identity
+        (if any) — checkpoint saves, canary readings, stage skips."""
+        if not self.enabled:
+            return
+        cur = _CURRENT.get()
+        if cur is not None:
+            fields.setdefault("trace", cur.trace_id)
+            fields.setdefault("span", cur.span_id)
+        self._journal_emit(ev, **fields)
+
+    def counters(self, scope: str, counters) -> None:
+        """Journal a named counter snapshot (the CLI renders per-scope
+        deltas between successive snapshots)."""
+        if not self.enabled:
+            return
+        self.event("counters", scope=scope, groups=counters.as_dict())
+
+    def gauge(self, name: str, value: float) -> None:
+        """Journal a point-in-time gauge reading (queue depths)."""
+        if not self.enabled:
+            return
+        self.event("gauge", name=name, value=value)
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process tracer (disabled, hence free, until configured)."""
+    return _TRACER
+
+
+def configure(conf) -> Tracer:
+    """Enable the process tracer from ``trace.*`` config keys; a no-op —
+    and one dict lookup — when ``trace.on`` is unset.
+
+    Multi-process runs keep every process but 0 disabled: the journal is
+    single-writer (the part-file writer protocol), and spans with nowhere
+    to land would be pure overhead.  Idempotent: a pipeline and the jobs
+    it runs all call this with the same conf; the first enable wins."""
+    t = _TRACER
+    if not conf.get_bool("trace.on", False) or t.enabled:
+        return t
+    try:
+        import jax
+
+        if jax.process_index() != 0:
+            return t
+    except Exception:                              # pragma: no cover
+        pass
+    max_mb = conf.get_float("telemetry.journal.max.mb", 64.0)
+    t.enable(conf.get("trace.journal.dir") or ".",
+             max_bytes=int(max_mb * (1 << 20)))
+    return t
+
+
+class CompileKeyMonitor:
+    """The serving batcher's compile-key diff, generalized (this round) so
+    every dispatch loop — batch chunk streams included — publishes a
+    measured ``recompiles`` counter instead of assuming shape stability.
+
+    ``prime`` registers expected keys (serving warmup; a stream's first
+    chunk) without counting; ``observe`` counts any key outside the known
+    set as a recompile, increments ``<group>::recompiles`` and journals a
+    ``recompile`` event carrying the fresh keys.  With ``auto_prime`` the
+    first observation primes instead of counting — the batch-stream mode,
+    where the first chunk's compile is the expected one and only
+    *subsequent* fresh shapes (e.g. a ragged tail chunk) are noteworthy."""
+
+    def __init__(self, counters=None, group: str = "Telemetry",
+                 scope: str = "", auto_prime: bool = False):
+        self.counters = counters
+        self.group = group
+        self.scope = scope
+        self.auto_prime = auto_prime
+        self._known: set = set()
+        self._primed = False
+
+    def prime(self, keys: Iterable) -> None:
+        self._known |= set(keys)
+        self._primed = True
+
+    @staticmethod
+    def shape_key(*arrays) -> tuple:
+        """A dispatch-shape key for array operands: (shape, dtype) per
+        operand — a fresh one implies a fresh XLA compile of the jitted
+        step consuming them."""
+        return tuple((tuple(a.shape), str(a.dtype))
+                     for a in arrays if a is not None)
+
+    def observe(self, keys: Iterable) -> int:
+        """Fold ``keys`` into the known set; returns (and accounts) how
+        many were fresh."""
+        fresh = set(keys) - self._known
+        if not fresh:
+            return 0
+        self._known |= fresh
+        if self.auto_prime and not self._primed:
+            self._primed = True
+            return 0
+        if self.counters is not None:
+            self.counters.increment(self.group, "recompiles", len(fresh))
+        _TRACER.event("recompile", scope=self.scope,
+                      keys=sorted(repr(k) for k in fresh))
+        return len(fresh)

@@ -54,10 +54,25 @@ class Counters:
             return {g: dict(d) for g, d in self._groups.items()}
 
     def merge(self, other: "Counters") -> "Counters":
-        """Adopt every counter from ``other`` (overwriting same-named ones)."""
+        """Adopt every counter from ``other`` (overwriting same-named ones)
+        — the "latest snapshot wins" semantics for republishing one source's
+        counters (e.g. a job adopting its batcher's final totals).  For
+        aggregating MANY sources into one report use :meth:`merge_add`:
+        overwrite-merge on same-named counters silently keeps only the last
+        contributor's count."""
         for group, vals in other.as_dict().items():
             for name, value in vals.items():
                 self.set(group, name, value)
+        return self
+
+    def merge_add(self, other: "Counters") -> "Counters":
+        """SUM every counter from ``other`` into this one — the
+        fleet/run-level aggregation semantics (Hadoop's counter merge):
+        per-stage or per-worker Counters folded into one rollup keep every
+        contributor's counts instead of last-writer-wins."""
+        for group, vals in other.as_dict().items():
+            for name, value in vals.items():
+                self.increment(group, name, value)
         return self
 
     def __repr__(self) -> str:
@@ -121,12 +136,23 @@ def serving_stats(counters: "Counters",
     percentiles.  Counter names inside the group: ``requests``, ``batches``,
     ``shed``, ``timeouts``, ``errors``, ``recompiles`` and the batched-size
     histogram ``bucket.<n>`` (the RL loop, which dispatches one event at a
-    time, reports everything under ``bucket.1``)."""
+    time, reports everything under ``bucket.1``).
+
+    Covers the UNION of the latency trackers and the ``Serving.<name>``
+    counter groups: a model that has counters but no tracker yet (e.g.
+    registered and shedding before its first scored request, or a fleet
+    rollup that only carried counters) reports with zeroed latency instead
+    of silently vanishing from the stats."""
     groups = counters.as_dict()
+    prefix = "Serving."
+    names = set(latency) | {g[len(prefix):] for g in groups
+                            if g.startswith(prefix)}
     out: Dict[str, dict] = {}
-    for name, tracker in latency.items():
+    for name in sorted(names):
         stats = dict(groups.get(f"Serving.{name}", {}))
-        stats.update(tracker.snapshot())
+        tracker = latency.get(name)
+        stats.update(tracker.snapshot() if tracker is not None else
+                     {"p50_ms": 0.0, "p99_ms": 0.0, "latency_samples": 0})
         out[name] = stats
     return out
 

@@ -77,12 +77,29 @@ def numpy_reference_rows_per_sec(codes, labels, n_classes, n_bins):
 
 
 def main():
+    # GraftTrace (round 10): AVENIR_TRACE_DIR opts the bench into the run
+    # journal — each pass becomes a span and each canary a journal event,
+    # so a regressed artifact ships its own timeline (``trace_artifact``
+    # below names it; `python -m avenir_tpu.telemetry <path>` renders it).
+    # Unset (the default), the tracer stays disabled: no journal file is
+    # created and the timed loop pays one attribute check per span site
+    # (benchmarks/telemetry_overhead.py publishes the measured on-state
+    # cost).
+    import os
+
+    from avenir_tpu.telemetry import spans as tel
+    tracer = tel.tracer()
+    trace_dir = os.environ.get("AVENIR_TRACE_DIR")
+    if trace_dir:
+        tracer.enable(trace_dir)
+
     # Rig-state canary FIRST (round 5): a bare-XLA 4096³ bf16 matmul,
     # measured before any framework kernel touches the chip, so every
     # artifact separates "rig slow" from "kernel regressed"
     # (utils/rig_canary.py).
     from avenir_tpu.utils.rig_canary import matmul_canary_ms
     canary_ms = matmul_canary_ms()
+    tracer.event("canary", ms=round(canary_ms, 2), when="pre_run")
 
     n_classes, n_bins, n_feat = 2, 12, 11      # hosp_readmit-shaped workload
     # 16M-row chunks amortize fixed per-dispatch cost (honest-sync
@@ -141,10 +158,16 @@ def main():
     # from kernel regression (canary flat while passes sag).
     passes = []
     canary_per_pass = []
-    for _ in range(5):
-        canary_per_pass.append(matmul_canary_ms())
-        rate, out = timed_pass()
-        passes.append(rate)
+    with tracer.span("bench.nb_mi", attrs={"chunk": chunk,
+                                           "n_chunks": n_chunks}):
+        for i in range(5):
+            canary_per_pass.append(matmul_canary_ms())
+            tracer.event("canary", ms=round(canary_per_pass[-1], 2),
+                         when=f"pass{i}")
+            with tracer.span("bench.pass", attrs={"pass": i}) as sp:
+                rate, out = timed_pass()
+                sp.set("rows_per_sec", round(rate, 1))
+            passes.append(rate)
     rows_per_sec = float(np.median(passes))
 
     # Canary-conditioned headline (round 7, closing the r05 verdict item):
@@ -206,6 +229,9 @@ def main():
                                if rows_per_sec_clean is not None else None),
         "canary_clean_passes": len(clean),
         "canary_healthy_threshold_ms": canary_healthy_ms,
+        # the run's own timeline when AVENIR_TRACE_DIR opted in (else null
+        # — and no journal file exists at all, the off-is-free contract)
+        "trace_artifact": tracer.journal_path,
     }
     line.update(mfu_fields(
         bytes_moved=n_chunks * chunk * bytes_per_row,

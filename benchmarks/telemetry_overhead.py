@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Measured cost of GraftTrace — the off-is-free contract, quantified.
+
+Two numbers per state (prints one JSON line):
+
+- ``span_ns`` — wall cost of one ``tracer().span(...)`` enter/exit,
+  median over batches of 10k spans.  Off: one attribute check returning
+  the shared NOOP span (no generator frame, no allocation, no I/O).  On
+  (journal to a tmpfile): two JSON lines written + flushed per span, the
+  price a traced run pays per unit of work.
+- ``bench_site_overhead_pct`` — the off-state span cost projected onto
+  the nb_mi bench's span sites per pass (a handful of spans around
+  multi-second device passes), documenting why the published
+  canary-clean band needs no widening with telemetry merged.
+
+Pure host-side measurement: no accelerator work, runs anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from avenir_tpu.telemetry.spans import Tracer
+
+SPANS_PER_BATCH = 10_000
+BATCHES = 7
+
+
+def measure_span_ns(tracer: Tracer) -> float:
+    rates = []
+    for _ in range(BATCHES):
+        t0 = time.perf_counter()
+        for _ in range(SPANS_PER_BATCH):
+            with tracer.span("probe"):
+                pass
+        rates.append((time.perf_counter() - t0) / SPANS_PER_BATCH * 1e9)
+    return float(np.median(rates))
+
+
+def measure() -> dict:
+    off = Tracer()                       # never enabled: the default state
+    off_ns = measure_span_ns(off)
+
+    on = Tracer()
+    with tempfile.TemporaryDirectory() as tmp:
+        on.enable(tmp)
+        on_ns = measure_span_ns(on)
+        journal_bytes = os.path.getsize(on.journal_path)
+        on.disable()
+
+    # the nb_mi bench adds ~7 span sites per run (one bench span, five
+    # pass spans, plus per-pass canary events); a pass is seconds of
+    # device time, so project the off cost onto one 1-second pass
+    bench_spans_per_pass = 2
+    overhead_pct = off_ns * bench_spans_per_pass / 1e9 / 1.0 * 100.0
+    return {
+        "metric": "telemetry_overhead",
+        "span_ns_off": round(off_ns, 1),
+        "span_ns_on_journaled": round(on_ns, 1),
+        "journal_bytes_per_span": round(journal_bytes
+                                        / (SPANS_PER_BATCH * BATCHES), 1),
+        "bench_site_overhead_pct": round(overhead_pct, 6),
+        "spans_per_batch": SPANS_PER_BATCH,
+        "batches": BATCHES,
+    }
+
+
+def main() -> None:
+    print(json.dumps(measure()))
+
+
+if __name__ == "__main__":
+    main()

@@ -353,10 +353,15 @@ def test_cli_clean_exits_zero(tmp_path):
 def test_whole_tree_zero_nonbaselined_findings():
     # tests/test_serving.py rides the gate too (round 9): serving tests
     # drive the hot dispatch loop directly, exactly where a per-iteration
-    # host sync (GL005) or an undocumented serve.* key (GL004) would hide
+    # host sync (GL005) or an undocumented serve.* key (GL004) would hide.
+    # tests/test_telemetry.py likewise (round 10) — telemetry tests drive
+    # traced pipelines end-to-end, where an undocumented trace.* key or a
+    # sync-in-loop would hide (avenir_tpu/telemetry/ itself is inside the
+    # avenir_tpu tree the gate already walks)
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
-         str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py")],
+         str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
+         str(REPO / "tests" / "test_telemetry.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

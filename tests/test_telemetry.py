@@ -1,0 +1,510 @@
+"""GraftTrace (avenir_tpu/telemetry) — tracing, journal, export, CLI.
+
+The heart is the end-to-end acceptance contract (ISSUE 5): ONE trace id
+flows from ``Pipeline.run`` through stage → job → chunk/feeder dispatch →
+serving request, the journal's span tree renders with the CLI, and the
+``/metrics`` route exposes the same counters in Prometheus text.  Around
+it: the off-is-free contract, journal single-writer/rotation/torn-tail
+discipline, the golden event schema (tier-1 stability gate), the
+generalized recompile monitor, and the satellite fixes (``merge_add``,
+skipped-stage reporting, zero-latency serving stats) — plus concurrency
+tests for the counter/latency primitives every thread shares.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.csv_io import write_csv
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.jobs import get_job
+from avenir_tpu.telemetry import spans as tel
+from avenir_tpu.telemetry.journal import Journal, read_events
+from avenir_tpu.utils.locking import LockHeldError
+from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """The tracer is process-wide; every test starts and ends disabled."""
+    tel.tracer().disable()
+    yield
+    tel.tracer().disable()
+
+
+@pytest.fixture(scope="module")
+def churn_ws(tmp_path_factory):
+    root = tmp_path_factory.mktemp("telemetry")
+    j = lambda *p: str(root.joinpath(*p))
+    rows = generate_churn(400, seed=7)
+    write_csv(j("train.csv"), rows[:320])
+    write_csv(j("test.csv"), rows[320:])
+    root.joinpath("churn.json").write_text(json.dumps(CHURN_SCHEMA_JSON))
+    return {"j": j, "schema": j("churn.json")}
+
+
+def _traced_pipeline(ws, j, schema, extra=None):
+    from avenir_tpu.pipeline.driver import Pipeline, Stage
+
+    conf = JobConfig({"feature.schema.file.path": schema,
+                      "stream.chunk.rows": "100", **(extra or {})})
+    p = Pipeline(ws, conf)
+    p.bind("train", j("train.csv"))
+    p.bind("test", j("test.csv"))
+    p.add(Stage("bayesianDistr", "BayesianDistribution", "train",
+                "bayes_model"))
+    p.add(Stage("serve", "ScoringPlane", "test", "scored",
+                props={"serve.models": "naiveBayes",
+                       "bayesian.model.file.path": "@bayes_model",
+                       "serve.bucket.sizes": "1,4,16"},
+                uses=("bayes_model",)))
+    return p
+
+
+# ---------------------------------------------------------------------------
+# off by default is free
+# ---------------------------------------------------------------------------
+
+def test_tracer_off_is_noop_and_writes_nothing(churn_ws, tmp_path):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    tel_dir = tmp_path / "tel"
+    # trace.on unset: journal dir named but never created, spans are the
+    # shared NOOP object (no allocation per call)
+    p = _traced_pipeline(str(tmp_path / "ws"), j, schema,
+                         extra={"trace.journal.dir": str(tel_dir)})
+    p.run()
+    assert not tel_dir.exists()
+    assert not tel.tracer().enabled
+    sp = tel.tracer().span("anything")
+    assert sp is tel.NOOP_SPAN
+    with sp as inner:
+        assert inner.block_on(123) == 123
+        inner.set("k", "v").event("whatever")   # all inert
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chain: one trace id, pipeline → serving, CLI, /metrics
+# ---------------------------------------------------------------------------
+
+def test_trace_links_pipeline_to_serving_end_to_end(churn_ws, tmp_path,
+                                                    capsys):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    p = _traced_pipeline(str(tmp_path / "ws"), j, schema,
+                         extra={"trace.on": "true",
+                                "trace.journal.dir": str(tmp_path / "tel")})
+    counters = p.run()
+    path = tel.tracer().journal_path
+    tel.tracer().disable()
+    events = read_events(path)
+
+    # ONE trace id across every span and event of the run
+    traces = {e["trace"] for e in events if "trace" in e}
+    assert len(traces) == 1
+
+    opens = {e["span"]: e for e in events if e["ev"] == "span.open"}
+    closes = {e["span"]: e for e in events if e["ev"] == "span.close"}
+    by_name = {}
+    for e in opens.values():
+        by_name.setdefault(e["name"], []).append(e)
+
+    # the chain: root run → stage → job → chunk dispatch → serving request
+    root = by_name["pipeline.run"][0]
+    assert root["parent"] is None
+    stage = by_name["stage.serve"][0]
+    assert stage["parent"] == root["span"]
+    job = by_name["job.ScoringPlane"][0]
+    assert job["parent"] == stage["span"]
+    requests = by_name["serve.request"]
+    assert requests, "no serving-request spans journaled"
+    assert all(r["parent"] == job["span"] for r in requests)
+    assert len(requests) == counters["serve"].get("Serving.naiveBayes",
+                                                  "requests")
+
+    # chunk dispatch spans under the train job (streamed at 100 rows/chunk)
+    train_job = by_name["job.BayesianDistribution"][0]
+    chunk_spans = [e for e in by_name.get("chunk", [])
+                   if e["parent"] == train_job["span"]]
+    assert len(chunk_spans) == 4                       # 320 rows / 100
+    assert by_name["feeder.stage"], "DeviceFeeder staging spans missing"
+    # every opened span closed, with a duration
+    assert set(opens) == set(closes)
+    assert all(c["dur_ms"] >= 0.0 for c in closes.values())
+
+    # per-stage counter snapshots + the merge_add rollup land as events
+    scopes = {e["scope"] for e in events if e["ev"] == "counters"}
+    assert {"bayesianDistr", "serve", "pipeline"} <= scopes
+
+    # the CLI renders the tree: stage names, durations, slowest-path mark
+    from avenir_tpu.telemetry.__main__ import main as tel_main
+
+    assert tel_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "pipeline.run" in out and "stage.serve" in out
+    assert "serve.request" in out and "◀" in out and "ms" in out
+    assert "counter deltas:" in out
+
+
+def test_metrics_endpoint_prometheus_text(churn_ws, tmp_path):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    get_job("BayesianDistribution").run(
+        JobConfig({"feature.schema.file.path": schema}),
+        j("train.csv"), str(tmp_path / "nb_model"))
+    from avenir_tpu.serving.batcher import BucketedMicrobatcher
+    from avenir_tpu.serving.frontend import ScoreHTTPServer
+    from avenir_tpu.serving.registry import ModelRegistry
+
+    conf = JobConfig({"feature.schema.file.path": schema,
+                      "serve.models": "naiveBayes",
+                      "bayesian.model.file.path": str(tmp_path / "nb_model"),
+                      "serve.bucket.sizes": "1,4"})
+    registry = ModelRegistry.from_conf(conf)
+    batcher = BucketedMicrobatcher.from_conf(registry, conf)
+    rows = [ln for ln in open(j("test.csv")).read().splitlines() if ln][:5]
+    with ScoreHTTPServer(batcher) as srv:
+        host, port = srv.address
+        for row in rows:
+            req = urllib.request.Request(
+                f"http://{host}:{port}/score",
+                data=json.dumps({"model": "naiveBayes",
+                                 "rows": [row]}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert urllib.request.urlopen(req).status == 200
+        resp = urllib.request.urlopen(f"http://{host}:{port}/metrics")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode()
+    batcher.close()
+    # the SAME counters the batcher reports, in Prometheus text format
+    served = batcher.counters.get("Serving.naiveBayes", "requests")
+    assert served == len(rows)
+    assert (f'avenir_counter_total{{group="Serving.naiveBayes",'
+            f'name="requests"}} {served}') in body
+    assert 'avenir_latency_seconds{model="naiveBayes",quantile="0.5"}' in body
+    assert 'avenir_latency_seconds_count{model="naiveBayes"}' in body
+    assert 'avenir_gauge{name="serve.queue.naiveBayes"} 0' in body
+    assert "# TYPE avenir_counter_total counter" in body
+
+
+# ---------------------------------------------------------------------------
+# journal discipline: single writer, rotation, torn tail
+# ---------------------------------------------------------------------------
+
+def test_journal_single_writer_detected(tmp_path):
+    path = str(tmp_path / "run-x.jsonl")
+    journal = Journal(path)
+    journal.emit("probe", n=1)
+    with pytest.raises(LockHeldError):
+        Journal(path)                     # second writer must be refused
+    journal.close()
+    second = Journal(path)                # lock released: reopen is fine
+    second.emit("probe", n=2)
+    second.close()
+    assert [e["n"] for e in read_events(path)] == [1, 2]
+
+
+def test_journal_tolerates_crash_mid_line(tmp_path):
+    path = str(tmp_path / "run-x.jsonl")
+    with Journal(path) as journal:
+        journal.emit("first", n=1)
+        journal.emit("second", n=2)
+    with open(path, "a") as fh:
+        fh.write('{"ev": "torn", "n": 3, "fiel')     # crash mid-write
+    events = read_events(path)
+    assert [e["ev"] for e in events] == ["first", "second"]
+    assert all(isinstance(e, dict) for e in events)
+
+
+def test_journal_rotation_bounds_growth(tmp_path):
+    path = str(tmp_path / "run-x.jsonl")
+    journal = Journal(path, max_bytes=1 << 12)
+    for i in range(200):                  # ~60 B/event ≫ 4 KiB budget
+        journal.emit("fill", n=i, pad="x" * 40)
+    journal.close()
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= (1 << 12)
+    events = read_events(path, with_rotated=True)
+    # rotation keeps the most recent window (current + one rotation)
+    assert events[-1]["n"] == 199
+    assert [e["n"] for e in events] == sorted(e["n"] for e in events)
+
+
+# ---------------------------------------------------------------------------
+# golden event schema — the journal's shape is tier-1-stable
+# ---------------------------------------------------------------------------
+
+GOLDEN_EVENT_KEYS = {
+    "span.open": {"ev", "ts", "trace", "span", "parent", "name", "attrs"},
+    "span.close": {"ev", "ts", "trace", "span", "name", "dur_ms", "status",
+                   "attrs"},
+    "counters": {"ev", "ts", "trace", "span", "scope", "groups"},
+    "gauge": {"ev", "ts", "trace", "span", "name", "value"},
+    "recompile": {"ev", "ts", "trace", "span", "scope", "keys"},
+    "checkpoint.save": {"ev", "ts", "trace", "span", "dir", "run", "rows",
+                        "chunk"},
+}
+
+
+def test_golden_event_shapes(tmp_path):
+    """Every journal event type keeps its exact key set: downstream
+    consumers (the CLI, dashboards, regression diffing) parse these
+    shapes, so a key rename/drop must fail CI, not their pipelines."""
+    tracer = tel.tracer().enable(str(tmp_path))
+    counters = Counters()
+    counters.increment("Records", "Processed", 5)
+    with tracer.span("run", attrs={"k": 1}):
+        tracer.counters("run", counters)
+        tracer.gauge("queue.depth", 3)
+        monitor = tel.CompileKeyMonitor(counters, scope="probe")
+        monitor.prime([(1,)])
+        monitor.observe([(2,)])
+        tracer.event("checkpoint.save", dir="d", run="r", rows=10, chunk=2)
+    path = tracer.journal_path
+    tel.tracer().disable()
+    seen = {}
+    for event in read_events(path):
+        seen.setdefault(event["ev"], set(event))
+    assert set(seen) == set(GOLDEN_EVENT_KEYS)
+    for ev, keys in GOLDEN_EVENT_KEYS.items():
+        assert seen[ev] == keys, f"{ev} schema drifted: {seen[ev]} != {keys}"
+    # root span.open: parent is present and null (roots are identifiable)
+    root_open = next(e for e in read_events(path) if e["ev"] == "span.open")
+    assert root_open["parent"] is None
+
+
+# ---------------------------------------------------------------------------
+# the generalized recompile monitor
+# ---------------------------------------------------------------------------
+
+def test_compile_key_monitor_counts_fresh_keys():
+    counters = Counters()
+    monitor = tel.CompileKeyMonitor(counters, group="Serving.m", scope="m")
+    monitor.prime([(1,), (2,)])
+    assert monitor.observe([(1,)]) == 0            # warmed: free
+    assert monitor.observe([(1,), (3,)]) == 1      # one fresh shape
+    assert monitor.observe([(3,)]) == 0            # now known
+    assert counters.get("Serving.m", "recompiles") == 1
+
+
+def test_compile_key_monitor_auto_prime_stream_mode():
+    counters = Counters()
+    monitor = tel.CompileKeyMonitor(counters, scope="stream",
+                                    auto_prime=True)
+    assert monitor.observe([("full",)]) == 0       # first chunk: expected
+    assert monitor.observe([("full",)]) == 0
+    assert monitor.observe([("ragged",)]) == 1     # tail chunk: counted
+    assert counters.get("Telemetry", "recompiles") == 1
+
+
+def test_fused_scan_counts_each_recompile_once(churn_ws, tmp_path):
+    """A streamed FUSED scan has one chunk stream and must account each
+    fresh dispatch shape exactly once — the stream-side monitor is the
+    single accounting home (a second monitor inside SharedScan would
+    double-count the same ragged tail chunk; review finding)."""
+    from avenir_tpu.pipeline.driver import Pipeline, Stage
+
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    conf = JobConfig({"feature.schema.file.path": schema,
+                      "stream.chunk.rows": "150"})     # 320 → 150+150+20
+    p = Pipeline(str(tmp_path / "ws"), conf)
+    p.bind("train", j("train.csv"))
+    p.add(Stage("nb", "BayesianDistribution", "train", "nb_model"))
+    p.add(Stage("mi", "MutualInformation", "train", "mi_out"))
+    counters = p.run()
+    first = counters["nb"]
+    assert first.get("SharedScan", "FusedStages") == 2   # fusion engaged
+    assert first.get("SharedScan", "Chunks") == 3
+    assert first.get("Telemetry", "recompiles") == 1     # ragged tail, once
+
+
+def test_batch_stream_publishes_recompiles_counter(churn_ws, tmp_path):
+    """A streamed job's ragged tail chunk is a fresh dispatch shape: the
+    serving-style compile-key diff now measures it for batch jobs too."""
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    counters = get_job("BayesianDistribution").run(
+        JobConfig({"feature.schema.file.path": schema,
+                   "stream.chunk.rows": "150"}),     # 320 → 150+150+20
+        j("train.csv"), str(tmp_path / "nb_stream"))
+    assert counters.get("Telemetry", "recompiles") == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: merge_add, skipped stages, zero-latency serving stats
+# ---------------------------------------------------------------------------
+
+def test_counters_merge_add_sums_where_merge_overwrites():
+    a, b = Counters(), Counters()
+    a.increment("Records", "Processed", 100)
+    b.increment("Records", "Processed", 50)
+    b.increment("Task", "Retries", 2)
+    merged = Counters().merge(a).merge(b)
+    assert merged.get("Records", "Processed") == 50       # last writer wins
+    summed = Counters().merge_add(a).merge_add(b)
+    assert summed.get("Records", "Processed") == 150      # fleet semantics
+    assert summed.get("Task", "Retries") == 2
+
+
+def test_pipeline_rollup_sums_across_stages(churn_ws, tmp_path):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    p = _traced_pipeline(str(tmp_path / "ws"), j, schema)
+    p.run()
+    rollup = p.rollup()
+    per_stage = sum(c.get("Records", "Processed")
+                    for c in p.counters.values())
+    assert rollup.get("Records", "Processed") == per_stage > 0
+
+
+def test_resume_reports_skipped_stages(churn_ws, tmp_path):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    p = _traced_pipeline(str(tmp_path / "ws"), j, schema)
+    p.run()
+    first = {name: c.as_dict() for name, c in p.counters.items()}
+    assert all(c.get("Pipeline", {}).get("skipped", 0) == 0
+               for c in first.values())
+    p.run(resume=True)
+    # every declared stage appears in the report, tagged as skipped
+    assert set(p.counters) == set(first)
+    for name in first:
+        assert p.counters[name].get("Pipeline", "skipped") == 1
+
+
+def test_resume_on_same_object_keeps_real_counters(churn_ws, tmp_path):
+    """A resume on the SAME Pipeline object (partial run + retry) must
+    mark skips in place, not clobber the counters the earlier execution
+    collected (review finding)."""
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    p = _traced_pipeline(str(tmp_path / "ws"), j, schema)
+    p.run()
+    processed = p.counters["bayesianDistr"].get("Records", "Processed")
+    assert processed > 0
+    p.run(resume=True)
+    kept = p.counters["bayesianDistr"]
+    assert kept.get("Records", "Processed") == processed
+    assert kept.get("Pipeline", "skipped") == 1
+
+
+def test_resume_skip_journals_an_event(churn_ws, tmp_path):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    ws = str(tmp_path / "ws")
+    _traced_pipeline(ws, j, schema).run()
+    p = _traced_pipeline(ws, j, schema,
+                         extra={"trace.on": "true",
+                                "trace.journal.dir": str(tmp_path / "tel")})
+    p.run(resume=True)
+    path = tel.tracer().journal_path
+    tel.tracer().disable()
+    skips = [e for e in read_events(path) if e["ev"] == "stage.skipped"]
+    assert {e["stage"] for e in skips} == {"bayesianDistr", "serve"}
+
+
+def test_serving_stats_reports_counter_only_models():
+    counters = Counters()
+    counters.increment("Serving.coldModel", "shed", 3)
+    tracker = LatencyTracker()
+    tracker.record(0.01)
+    stats = serving_stats(counters, {"hotModel": tracker})
+    # registered-but-never-scored: present, zeroed latency — not omitted
+    assert set(stats) == {"coldModel", "hotModel"}
+    assert stats["coldModel"]["shed"] == 3
+    assert stats["coldModel"]["p50_ms"] == 0.0
+    assert stats["coldModel"]["latency_samples"] == 0
+    assert stats["hotModel"]["latency_samples"] == 1
+
+
+# ---------------------------------------------------------------------------
+# concurrency: the primitives every serving/fleet thread shares
+# ---------------------------------------------------------------------------
+
+def _hammer(n_threads, fn):
+    errs = []
+
+    def body():
+        try:
+            fn()
+        except BaseException as e:                # surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=body) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+
+
+def test_counters_increment_concurrent():
+    counters = Counters()
+    per_thread, n_threads = 2000, 8
+    _hammer(n_threads, lambda: [counters.increment("G", "n")
+                                for _ in range(per_thread)])
+    assert counters.get("G", "n") == per_thread * n_threads
+
+
+def test_latency_tracker_concurrent_record_and_percentile():
+    tracker = LatencyTracker(capacity=256)
+    per_thread, n_threads = 1000, 6
+
+    def mixed():
+        for i in range(per_thread):
+            tracker.record(0.001 * (i % 10 + 1))
+            if i % 50 == 0:
+                p50, p99 = tracker.percentile(50), tracker.percentile(99)
+                assert 0.0 <= p50 <= p99 <= 0.010 + 1e-9
+
+    _hammer(n_threads, mixed)
+    assert tracker.count == per_thread * n_threads
+    snap = tracker.snapshot()
+    assert snap["latency_samples"] == tracker.count
+    assert snap["p99_ms"] >= snap["p50_ms"] > 0.0
+
+
+def test_journal_emit_concurrent_threads_valid_jsonl(tmp_path):
+    path = str(tmp_path / "run-x.jsonl")
+    journal = Journal(path)
+    per_thread, n_threads = 500, 8
+    _hammer(n_threads, lambda: [journal.emit("tick", n=i)
+                                for i in range(per_thread)])
+    journal.close()
+    events = read_events(path)
+    assert len(events) == per_thread * n_threads    # no torn/interleaved line
+    assert all(e["ev"] == "tick" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# CLI details
+# ---------------------------------------------------------------------------
+
+def test_cli_marks_open_spans_and_slowest_path(tmp_path, capsys):
+    tracer = tel.tracer().enable(str(tmp_path))
+    with tracer.span("run"):
+        with tracer.span("fast"):
+            pass
+        journal = tracer.journal
+        # simulate a wedged child: open, never closed (crash mid-run)
+        journal.emit("span.open", trace=tracer.current().trace_id,
+                     span="s999", parent=tracer.current().span_id,
+                     name="wedged", attrs={})
+    path = tracer.journal_path
+    tel.tracer().disable()
+    from avenir_tpu.telemetry.__main__ import main as tel_main
+
+    assert tel_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "wedged" in out and "OPEN" in out
+    # the open (wedged) child IS the slowest path
+    wedged_line = next(ln for ln in out.splitlines() if "wedged" in ln)
+    assert "◀" in wedged_line
+
+
+def test_cli_json_and_missing_file(tmp_path, capsys):
+    from avenir_tpu.telemetry.__main__ import main as tel_main
+
+    with Journal(str(tmp_path / "j.jsonl")) as journal:
+        journal.emit("gauge", name="q", value=1)
+    assert tel_main([str(tmp_path / "j.jsonl"), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload[0]["ev"] == "gauge"
+    assert tel_main([str(tmp_path / "nope.jsonl")]) == 2
