@@ -63,19 +63,27 @@ def iter_input_chunks(path: str, chunk_rows: int = 1_000_000,
         yield from iter_csv_chunks(f, chunk_rows=chunk_rows, delim=delim)
 
 
+def output_target(path: str, part: str = PART_FILE) -> str:
+    """Resolve a job output path to its writable target (creating parent
+    dirs): ``<path>/<part>`` for the MR directory layout, or ``path``
+    itself when it already names a plain file (has an extension) — the
+    single definition behind :func:`write_output` and the streaming jobs
+    that write their part file incrementally."""
+    if path.endswith(os.sep) or not os.path.splitext(path)[1]:
+        os.makedirs(path, exist_ok=True)
+        return os.path.join(path, part)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    return path
+
+
 def write_output(path: str, lines: Sequence[str], part: str = PART_FILE) -> str:
     """Write job output lines under ``<path>/<part>`` (MR layout); returns the
     part-file path. A path that already names a file (has an extension and a
     non-dir parent semantic) is honored as a plain file for single-artifact
     outputs like the LR coefficient file."""
-    if path.endswith(os.sep) or not os.path.splitext(path)[1]:
-        os.makedirs(path, exist_ok=True)
-        target = os.path.join(path, part)
-    else:
-        parent = os.path.dirname(path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        target = path
+    target = output_target(path, part)
     with open(target, "w") as fh:
         for line in lines:
             fh.write(line)

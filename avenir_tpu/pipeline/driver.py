@@ -18,7 +18,6 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
 from avenir_tpu.core.config import JobConfig
-from avenir_tpu.jobs import get_job
 from avenir_tpu.utils.metrics import Counters
 
 
@@ -37,6 +36,11 @@ class Stage:
     uses: Sequence[str] = ()
 
     def run(self, conf: JobConfig, in_path: str, out_path: str) -> Counters:
+        # resolved at call time: a module-level jobs import would close the
+        # import cycle jobs/__init__ → stream → pipeline → driver → jobs
+        # (any avenir_tpu.stream-first import would crash at startup)
+        from avenir_tpu.jobs import get_job
+
         runner = get_job(self.job).run if isinstance(self.job, str) else self.job
         return runner(conf, in_path, out_path)
 

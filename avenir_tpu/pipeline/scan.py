@@ -256,6 +256,149 @@ class MomentsConsumer(ScanConsumer):
         return t.moments
 
 
+class ChunkFolder:
+    """One SharedScan chunk pass, factored out for external accumulation.
+
+    Captures the fit-static routing ONCE from the consumer set and the
+    stream's shape metadata — the count-path selection (kernel fast path,
+    sharded-kernel mesh path, or the einsum fallback: the SAME three-way
+    routing as ``MutualInformation.fit``), the layout-qualified gram key,
+    the union of required pairs, and the moments flag — then folds any
+    number of chunks into *caller-owned* :class:`~avenir_tpu.ops.agg.Accumulator`
+    objects.  :class:`SharedScan` folds the whole stream into one
+    accumulator; ``stream/windows.py`` folds each pane into its own and
+    merges panes per window — windowed results are byte-identical to a
+    batch scan over the same rows *because both paths run exactly this
+    fold*, not a parallel implementation.
+    """
+
+    def __init__(self, consumers: Sequence[ScanConsumer],
+                 meta: EncodedDataset, mesh=None, pair_chunk: int = 256):
+        from avenir_tpu.ops import pallas_hist
+
+        if not consumers:
+            raise ScanError("no consumers registered")
+        self.consumers = list(consumers)
+        self.meta = meta
+        self.mesh = mesh
+        self.pair_chunk = pair_chunk
+        f, b, c = meta.num_binned, meta.max_bins, meta.num_classes
+        self.f, self.b, self.c = f, b, c
+        self.needs_counts = any(x.needs_bin or x.needs_pairs
+                                for x in self.consumers) and f > 0 and b > 0
+        self.needs_moments = any(x.needs_moments
+                                 for x in self.consumers) and meta.num_cont > 0
+        # union of the pairs any consumer reads, in sorted (i, j) order —
+        # for an MI consumer that IS the all-i<j row-major index; a
+        # correlation stage restricted to a few pairs aggregates only those
+        union = sorted({p for x in self.consumers
+                        for p in x.required_pairs(f)})
+        self.pair_index = (np.array(union, np.int32).reshape(-1, 2) if union
+                           else np.zeros((0, 2), np.int32))
+        # count-path routing: single source of truth with the standalone
+        # fast paths (MutualInformation.fit / bench.py / e2e_pipeline)
+        self.step = self._sharded = None
+        if self.needs_counts:
+            if pallas_hist.use_kernel(f, b, c, mesh=self.mesh):
+                self.step = "kernel"
+            elif (pallas_hist.applicable(f, b, c)
+                    and pallas_hist.mesh_on_tpu(self.mesh)):
+                from avenir_tpu.parallel import collectives
+                self._sharded = collectives.sharded_cooc_step(self.mesh, b, c)
+                self.step = "sharded"
+            else:
+                self.step = "einsum"
+        self.gk = pallas_hist.g_key(f, b, c)
+
+    def fold(self, ds: EncodedDataset, acc: agg.Accumulator) -> None:
+        """One chunk's device pass + 64-bit host accumulation into ``acc``."""
+        from avenir_tpu.ops import pallas_hist
+        from avenir_tpu.parallel.mesh import maybe_shard_batch
+
+        codes, labels, cont = maybe_shard_batch(
+            self.mesh, ds.codes, ds.labels, ds.cont)
+        acc.add("class", agg.class_counts(labels, self.c))
+        moments_done = False
+        if self.step == "kernel":
+            if self.needs_moments:
+                # one fused dispatch: gram + moments of the resident chunk
+                g, cnt, s1, s2 = pallas_hist.gram_moments(
+                    codes, labels, cont, self.b, self.c)
+                acc.add(self.gk, g)
+                acc.add("cont_count", cnt)
+                acc.add("cont_sum", s1)
+                acc.add("cont_sumsq", s2)
+                moments_done = True
+            else:
+                acc.add(self.gk, pallas_hist.cooc_counts(
+                    codes, labels, self.b, self.c))
+        elif self.step == "sharded":
+            acc.add(self.gk, self._sharded(codes, labels))
+        elif self.step == "einsum":
+            acc.add("fc", agg.feature_class_counts(codes, labels,
+                                                   self.c, self.b))
+            for s in range(0, len(self.pair_index), self.pair_chunk):
+                sl = self.pair_index[s:s + self.pair_chunk]
+                # SharedScan accumulators live only for one fused scan,
+                # and windowed pane accumulators carry the conf-derived
+                # run fingerprint in their snapshot envelope
+                # (stream/windows.py), so no restore path exists for a
+                # stale key to corrupt; keys mirror
+                # models/mutual_info.py's gated family
+                # graftlint: disable=GL002
+                acc.add(f"pcc{s}", agg.pair_class_counts(
+                    codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels,
+                    self.c, self.b))
+        if self.needs_moments and not moments_done:
+            cnt, s1, s2 = agg.class_moments(cont, labels, self.c)
+            acc.add("cont_count", cnt)
+            acc.add("cont_sum", s1)
+            acc.add("cont_sumsq", s2)
+
+    def tables(self, acc: agg.Accumulator, rows: int) -> ScanTables:
+        """The shared per-stream totals from an accumulator this folder
+        filled.  Tolerates an EMPTY accumulator (a window whose panes held
+        zero rows): every table the consumers need comes back all-zero, so
+        empty windows finalize deterministically instead of raising."""
+        from avenir_tpu.ops import pallas_hist
+
+        f, b, c = self.f, self.b, self.c
+        fbc = pcc = None
+        if self.needs_counts and self.gk in acc:
+            fbc, pcc = pallas_hist.counts_from_cooc(
+                acc.get(self.gk), f, b, c,
+                self.pair_index[:, 0], self.pair_index[:, 1])
+        elif self.needs_counts:
+            fbc = (acc.get("fc") if "fc" in acc
+                   else np.zeros((f, b, c), np.int64))
+            pcc = (np.concatenate(
+                [acc.get(f"pcc{s}") if f"pcc{s}" in acc
+                 else np.zeros((min(self.pair_chunk,
+                                    len(self.pair_index) - s), b, b, c),
+                               np.int64)
+                 for s in range(0, len(self.pair_index), self.pair_chunk)])
+                if len(self.pair_index) else np.zeros((0, b, b, c), np.int64))
+        moments = None
+        if self.needs_moments:
+            fc = self.meta.num_cont
+            moments = ((acc.get("cont_count"), acc.get("cont_sum"),
+                        acc.get("cont_sumsq")) if "cont_count" in acc
+                       else (np.zeros(c, np.float64),
+                             np.zeros((c, fc), np.float64),
+                             np.zeros((c, fc), np.float64)))
+        return ScanTables(
+            meta=self.meta, rows=rows,
+            class_counts=(acc.get("class") if "class" in acc
+                          else np.zeros(c, np.int64)),
+            fbc=fbc, pair_index=self.pair_index, pcc=pcc, moments=moments)
+
+    def finalize(self, acc: agg.Accumulator, rows: int) -> Dict[str, Any]:
+        """``{consumer.name: result}`` from an accumulator this folder
+        filled — the end-of-stream (or end-of-window) read-out."""
+        tables = self.tables(acc, rows)
+        return {cons.name: cons.finalize(tables) for cons in self.consumers}
+
+
 class SharedScan:
     """Consumer registry + one-pass dispatch over an encoded chunk stream.
 
@@ -265,6 +408,8 @@ class SharedScan:
     the SAME three-way routing as ``MutualInformation.fit``) and/or the
     continuous class moments, fused into one dispatch on the kernel path —
     and accumulates 64-bit host totals.  Returns ``{consumer.name: result}``.
+    The per-chunk pass itself lives in :class:`ChunkFolder` so windowed
+    streaming consumers (``stream/windows.py``) fold the exact same code.
     """
 
     def __init__(self, mesh=None, pair_chunk: int = 256):
@@ -287,49 +432,22 @@ class SharedScan:
             ) -> Dict[str, Any]:
         if not self._consumers:
             raise ScanError("no consumers registered")
-        from avenir_tpu.ops import pallas_hist
-
         meta, chunks = peek_chunks(data)
         if meta.labels is None:
             raise ScanError(
                 "SharedScan requires labels: every shared table is "
                 "class-conditioned (see the row-validity contract)")
-        f, b, c = meta.num_binned, meta.max_bins, meta.num_classes
-        needs_counts = any(x.needs_bin or x.needs_pairs
-                           for x in self._consumers) and f > 0 and b > 0
-        needs_moments = any(x.needs_moments
-                            for x in self._consumers) and meta.num_cont > 0
-        # union of the pairs any consumer reads, in sorted (i, j) order —
-        # for an MI consumer that IS the all-i<j row-major index; a
-        # correlation stage restricted to a few pairs aggregates only those
-        union = sorted({p for x in self._consumers
-                        for p in x.required_pairs(f)})
-        pair_index = (np.array(union, np.int32).reshape(-1, 2) if union
-                      else np.zeros((0, 2), np.int32))
-        needs_pairs = bool(union)
-        # count-path routing: single source of truth with the standalone
-        # fast paths (MutualInformation.fit / bench.py / e2e_pipeline)
-        step = sharded = None
-        if needs_counts:
-            if pallas_hist.use_kernel(f, b, c, mesh=self.mesh):
-                step = "kernel"
-            elif (pallas_hist.applicable(f, b, c)
-                    and pallas_hist.mesh_on_tpu(self.mesh)):
-                from avenir_tpu.parallel import collectives
-                sharded = collectives.sharded_cooc_step(self.mesh, b, c)
-                step = "sharded"
-            else:
-                step = "einsum"
+        folder = ChunkFolder(self._consumers, meta, mesh=self.mesh,
+                             pair_chunk=self.pair_chunk)
         from avenir_tpu.telemetry import spans as tel
 
         tracer = tel.tracer()
-        gk = pallas_hist.g_key(f, b, c)
         acc = agg.Accumulator()
         rows = 0
         self.chunks_seen = 0
         with tracer.span("scan", attrs={
                 "consumers": [x.name for x in self._consumers],
-                "path": step or "moments"}) as scan_span:
+                "path": folder.step or "moments"}) as scan_span:
             for ds in chunks:
                 with tracer.span("scan.chunk",
                                  attrs={"chunk": self.chunks_seen,
@@ -339,79 +457,12 @@ class SharedScan:
                     # Recompile accounting lives with the chunk SOURCE
                     # (jobs' _chunk_telemetry) — a second monitor here
                     # would double-count the same stream
-                    self._scan_chunk(ds, acc, step, sharded, gk, b, c,
-                                     pair_index, needs_moments)
+                    folder.fold(ds, acc)
                 rows += ds.num_rows
                 self.chunks_seen += 1
             scan_span.set("chunks", self.chunks_seen)
             scan_span.set("rows", rows)
-        return self._finalize(acc, meta, rows, f, b, c, gk, pair_index,
-                              needs_counts, needs_moments)
-
-    def _scan_chunk(self, ds, acc, step, sharded, gk, b, c, pair_index,
-                    needs_moments) -> None:
-        """One chunk's device pass + 64-bit host accumulation (the body of
-        :meth:`run`'s stream loop, factored out for per-chunk spans)."""
-        from avenir_tpu.ops import pallas_hist
-        from avenir_tpu.parallel.mesh import maybe_shard_batch
-
-        codes, labels, cont = maybe_shard_batch(
-            self.mesh, ds.codes, ds.labels, ds.cont)
-        acc.add("class", agg.class_counts(labels, c))
-        moments_done = False
-        if step == "kernel":
-            if needs_moments:
-                # one fused dispatch: gram + moments of the resident chunk
-                g, cnt, s1, s2 = pallas_hist.gram_moments(
-                    codes, labels, cont, b, c)
-                acc.add(gk, g)
-                acc.add("cont_count", cnt)
-                acc.add("cont_sum", s1)
-                acc.add("cont_sumsq", s2)
-                moments_done = True
-            else:
-                acc.add(gk, pallas_hist.cooc_counts(codes, labels, b, c))
-        elif step == "sharded":
-            acc.add(gk, sharded(codes, labels))
-        elif step == "einsum":
-            acc.add("fc", agg.feature_class_counts(codes, labels, c, b))
-            for s in range(0, len(pair_index), self.pair_chunk):
-                sl = pair_index[s:s + self.pair_chunk]
-                # SharedScan accumulators live only for one fused scan
-                # (checkpointed stages never fuse — stage_fusable), so
-                # no restore path exists for a stale key to corrupt;
-                # keys mirror models/mutual_info.py's gated family
-                # graftlint: disable=GL002
-                acc.add(f"pcc{s}", agg.pair_class_counts(
-                    codes[:, sl[:, 0]], codes[:, sl[:, 1]], labels, c, b))
-        if needs_moments and not moments_done:
-            cnt, s1, s2 = agg.class_moments(cont, labels, c)
-            acc.add("cont_count", cnt)
-            acc.add("cont_sum", s1)
-            acc.add("cont_sumsq", s2)
-
-    def _finalize(self, acc, meta, rows, f, b, c, gk, pair_index,
-                  needs_counts, needs_moments) -> Dict[str, Any]:
-        from avenir_tpu.ops import pallas_hist
-
-        fbc = pcc = None
-        if needs_counts and gk in acc:
-            fbc, pcc = pallas_hist.counts_from_cooc(
-                acc.get(gk), f, b, c, pair_index[:, 0], pair_index[:, 1])
-        elif needs_counts:
-            fbc = acc.get("fc")
-            pcc = (np.concatenate(
-                [acc.get(f"pcc{s}")
-                 for s in range(0, len(pair_index), self.pair_chunk)])
-                if len(pair_index) else np.zeros((0, b, b, c), np.int64))
-        moments = None
-        if needs_moments and "cont_count" in acc:
-            moments = (acc.get("cont_count"), acc.get("cont_sum"),
-                       acc.get("cont_sumsq"))
-        tables = ScanTables(meta=meta, rows=rows,
-                            class_counts=acc.get("class"), fbc=fbc,
-                            pair_index=pair_index, pcc=pcc, moments=moments)
-        return {cons.name: cons.finalize(tables) for cons in self._consumers}
+        return folder.finalize(acc, rows)
 
 
 # ---------------------------------------------------------------------------

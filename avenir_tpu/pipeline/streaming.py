@@ -33,14 +33,51 @@ from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
 # queue transports
 # ---------------------------------------------------------------------------
 
-class InProcQueue:
-    """Deque-backed FIFO with the push/pop surface the Redis impls use."""
+class QueueFullError(RuntimeError):
+    """Typed backpressure: a push against a bounded queue at its depth cap.
 
-    def __init__(self):
+    The in-proc analog of the scoring plane's ShedError — load is rejected
+    at the door with a type the producer can catch (drop, block, or shed
+    upstream), instead of the queue growing without bound until the process
+    OOMs mid-stream."""
+
+
+class InProcQueue:
+    """Deque-backed FIFO with the push/pop surface the Redis impls use.
+
+    Bounded: ``depth`` (``stream.queue.depth``, default 65536) caps the
+    backlog; a push past the cap raises :class:`QueueFullError`.
+    ``depth=0`` disables the cap — only for tests that model an external
+    broker's durability, never for a production in-proc hop."""
+
+    DEFAULT_DEPTH = 65536
+
+    def __init__(self, depth: int = DEFAULT_DEPTH):
         self._q = deque()
+        self.depth = max(int(depth), 0)
 
     def push(self, msg: str) -> None:
+        # len+appendleft is not atomic across threads, so a concurrent
+        # producer pair can land at depth+1 — the cap bounds GROWTH (its
+        # job), it is not an exact high-water mark
+        if self.depth and len(self._q) >= self.depth:
+            raise QueueFullError(
+                f"in-proc queue at depth cap {self.depth} — consumer is "
+                f"not keeping up; shed, block, or raise stream.queue.depth")
         self._q.appendleft(msg)
+
+    def push_all(self, msgs: Iterable[str]) -> None:
+        """All-or-nothing batch push: either every message is enqueued or
+        none is (:class:`QueueFullError`).  Same growth-bound (not exact
+        high-water) concurrency caveat as :meth:`push`."""
+        batch = list(msgs)
+        if self.depth and len(self._q) + len(batch) > self.depth:
+            raise QueueFullError(
+                f"in-proc queue cannot take {len(batch)} messages within "
+                f"depth cap {self.depth} — consumer is not keeping up; "
+                f"shed, block, or raise stream.queue.depth")
+        for m in batch:
+            self._q.appendleft(m)
 
     def pop(self) -> Optional[str]:
         return self._q.pop() if self._q else None
@@ -111,8 +148,17 @@ class QueueActionWriter:
         self.delim = delim
 
     def write(self, event_id: str, actions: List[str]) -> None:
-        for a in actions:
-            self.queue.push(f"{event_id}{self.delim}{a}")
+        msgs = [f"{event_id}{self.delim}{a}" for a in actions]
+        push_all = getattr(self.queue, "push_all", None)
+        if push_all is not None:
+            # all-or-nothing on bounded queues: the serving loop's shed
+            # path treats QueueFullError as "this event's actions dropped",
+            # so a multi-action selection must never publish a partial set
+            push_all(msgs)
+        else:
+            # uncapped broker transports (Redis LPUSH) never shed
+            for m in msgs:
+                self.queue.push(m)
 
 
 # Redis transports — the reference's spout/reader/writer contract
@@ -192,7 +238,16 @@ class ReinforcementLearnerServer:
         for action, reward in self.rewards.read_rewards():
             self.learner.set_reward(action, reward)
         selected = self.learner.next_actions(round_num)
-        self.actions.write(event_id, selected)
+        try:
+            self.actions.write(event_id, selected)
+        except QueueFullError:
+            # bounded action queue + lagging consumer: SHED this event's
+            # actions (counted) and keep serving — the deployed
+            # ``replay.failed.message=false`` drop semantics; the learner
+            # update above already happened, and dying mid-serve (or
+            # growing the queue without bound, the pre-round-11 behavior)
+            # are both strictly worse
+            self.counters.increment(f"Serving.{self.model_name}", "shed")
         self.processed += 1
         self.latency.record(time.monotonic() - t0)
         group = f"Serving.{self.model_name}"

@@ -142,6 +142,37 @@ class BucketedMicrobatcher:
             self._monitors[name].prime(entry.compile_keys)
         return warmed
 
+    # -- hot swap (any thread) -----------------------------------------------
+    def swap(self, model: str, entry, warm: bool = True) -> int:
+        """Zero-downtime model hot-swap with the compile barrier.
+
+        Warms the INCOMING entry's bucket shapes and primes its recompile
+        monitor BEFORE publishing it to the registry, so the first
+        post-swap batch scores on already-compiled shapes — the
+        zero-steady-state-recompiles invariant holds ACROSS a swap, not
+        just between swaps.  In-flight batches hold the old entry object
+        they resolved at dispatch and finish on the old params; every
+        batch dispatched after the publish resolves the new entry.
+        Documented exception to the one-dispatcher-thread rule: the
+        warmup compiles run on the CALLER's thread concurrently with live
+        dispatches (JAX is thread-safe; routing them through the
+        dispatcher would stall the same batches behind the same compiles)
+        — expect a p99 bump for the duration of a swap either way.
+        ``warm=False`` (``serve.swap.warmup``) skips the barrier — the
+        first post-swap batch then pays the compile on the hot path and
+        the monitor counts it, which is exactly the visibility the
+        default exists to avoid.  Returns the model's new version."""
+        self.registry.get(model)          # raises UnknownModelError early
+        if warm:
+            for bucket in self.buckets:
+                entry.warmup(int(bucket))
+            self._monitors[model].prime(entry.compile_keys)
+        version = self.registry.swap(model, entry)
+        self.counters.increment(f"Serving.{model}", "swaps")
+        tel.tracer().event("model.swap", model=model, version=version,
+                           family=entry.family, warmed=bool(warm))
+        return version
+
     # -- submission (any thread) ---------------------------------------------
     def submit_nowait(self, model: str, line: str) -> PendingRequest:
         entry = self.registry.get(model)            # raises UnknownModelError

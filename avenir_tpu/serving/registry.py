@@ -486,13 +486,31 @@ FAMILIES: Dict[str, type] = {
 
 
 class ModelRegistry:
-    """name → :class:`ServableModel`; the scoring plane's model namespace."""
+    """name → :class:`ServableModel`; the scoring plane's model namespace.
+
+    Entries are VERSIONED: :meth:`swap` atomically replaces a loaded entry
+    with a freshly built one (the drift→retrain→hot-swap seam,
+    ``stream/controller.py``) and bumps the model's version.  ``get`` hands
+    out the entry object itself, so a dispatch that already resolved the
+    old entry finishes scoring on the old params while every later ``get``
+    sees the new ones — zero-downtime swap with no request ever observing
+    half a model.  Use :meth:`~avenir_tpu.serving.batcher.BucketedMicrobatcher.swap`
+    rather than calling this directly under a live batcher: the batcher
+    warms the incoming entry's bucket shapes BEFORE publishing it (the
+    swap barrier), so the zero-steady-state-recompiles invariant survives
+    the swap."""
 
     def __init__(self) -> None:
+        import threading
+
         self._entries: Dict[str, ServableModel] = {}
+        self._versions: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def add(self, name: str, entry: ServableModel) -> "ModelRegistry":
-        self._entries[name] = entry
+        with self._lock:
+            self._entries[name] = entry
+            self._versions[name] = self._versions.get(name, 0) + 1
         return self
 
     def get(self, name: str) -> ServableModel:
@@ -502,6 +520,26 @@ class ModelRegistry:
             raise UnknownModelError(
                 f"unknown model {name!r}; loaded: {sorted(self._entries)}")
         return entry
+
+    def swap(self, name: str, entry: ServableModel) -> int:
+        """Atomically replace a LOADED entry; returns the new version.
+        Swapping an unknown name raises (publish new models with ``add`` —
+        a swap that silently creates a model would hide a routing typo)."""
+        from avenir_tpu.serving.errors import UnknownModelError
+
+        with self._lock:
+            if name not in self._entries:
+                raise UnknownModelError(
+                    f"cannot swap unknown model {name!r}; loaded: "
+                    f"{sorted(self._entries)}")
+            self._entries[name] = entry
+            self._versions[name] += 1
+            return self._versions[name]
+
+    def version(self, name: str) -> int:
+        """The entry's version (1 = initial load, +1 per swap)."""
+        self.get(name)                    # raises UnknownModelError
+        return self._versions[name]
 
     def names(self) -> List[str]:
         return sorted(self._entries)

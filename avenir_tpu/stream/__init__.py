@@ -1,0 +1,32 @@
+"""StreamGraft — the continuous-analytics plane (ROADMAP item 4).
+
+Sliding-window SharedScan consumers over infinite row streams
+(:mod:`~avenir_tpu.stream.windows`), count-distribution drift detection
+(:mod:`~avenir_tpu.stream.drift`), and the drift→retrain→hot-swap
+controller closing the train→deploy loop through ServeGraft
+(:mod:`~avenir_tpu.stream.controller`).  ``StreamAnalytics``
+(:mod:`~avenir_tpu.stream.job`) is the pipeline-stage face.
+"""
+
+from avenir_tpu.stream.controller import RETRAIN_JOBS, DriftRetrainController
+from avenir_tpu.stream.drift import DriftDetector, DriftEvent
+from avenir_tpu.stream.job import StreamAnalytics, consumers_from_conf
+from avenir_tpu.stream.windows import (
+    ClassDistributionConsumer,
+    WindowCheckpointer,
+    WindowedScan,
+    WindowResult,
+)
+
+__all__ = [
+    "ClassDistributionConsumer",
+    "DriftDetector",
+    "DriftEvent",
+    "DriftRetrainController",
+    "RETRAIN_JOBS",
+    "StreamAnalytics",
+    "WindowCheckpointer",
+    "WindowedScan",
+    "WindowResult",
+    "consumers_from_conf",
+]

@@ -1,0 +1,455 @@
+"""StreamGraft windows — constant-memory sliding-window analytics over the
+SharedScan fold.
+
+The reference's only online path is the Storm RL topology; every analytical
+statistic is a batch replay over HDFS files (SURVEY §0).  This module makes
+the continuous case first-class: a :class:`WindowedScan` pulls micro-batches
+of raw CSV rows from any queue transport (``pipeline/streaming.py``'s
+``InProcQueue`` / ``RedisListQueue`` — the push/pop surface the reference's
+spout uses), encodes them through the existing chunk path, and folds each
+*pane* through :class:`~avenir_tpu.pipeline.scan.ChunkFolder` — the SAME
+per-chunk gram/moments pass every batch SharedScan runs — into a
+ring-buffered per-pane accumulator state.
+
+Windows are pane-composed:
+
+- a **pane** is ``pane_rows`` consecutive rows, folded once on arrival into
+  its own fingerprinted count state (int64/float64 host totals);
+- a **tumbling** window is ``window_panes`` panes with
+  ``slide_panes == window_panes``;
+- a **sliding** window overlaps: every ``slide_panes`` panes, the last
+  ``window_panes`` pane states are merged (pure host adds of already-folded
+  totals — each row is encoded and dispatched exactly ONCE no matter how
+  many windows contain it, the O(1)-state incremental discipline of
+  PAPERS.md's constant-memory caching applied to count analytics).
+
+A window finalizes through the consumers' data-free constructors
+(``result_from_counts`` / ``model_from_counts``), so a window's result is
+byte-identical to a batch SharedScan over the same rows — the acceptance
+oracle (tests/test_stream.py).  Scope of that claim: exact ALWAYS for
+every count-derived table (integer accumulation); for continuous moments
+the per-pane float32 partial sums merge in float64, so equality with a
+single-chunk batch fold additionally needs the partial sums exact (e.g.
+values on a coarse binary grid, as the tests construct) or the batch
+oracle fed the same pane chunking — general real-valued data can differ
+in the last float bit, exactly like any re-chunked streaming fit.
+
+Shape discipline: panes are padded to power-of-two row buckets
+(``stream.pane.pad.pow2``) with rows whose label is −1 — the row-validity
+contract drops such rows from EVERY table on both the kernel and einsum
+paths, so padding changes no counts while keeping the compiled-shape set
+finite.  ``warm()`` pre-compiles every bucket shape and primes a
+:class:`~avenir_tpu.telemetry.spans.CompileKeyMonitor`, so steady-state
+streaming (ragged tail panes included) recompiles ZERO times — measured,
+not assumed (``benchmarks/streaming_soak.py``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from avenir_tpu.core.config import ConfigError, JobConfig
+from avenir_tpu.core.csv_io import read_csv_string
+from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.ops import agg
+from avenir_tpu.pipeline import scan
+from avenir_tpu.telemetry import spans as tel
+from avenir_tpu.utils.metrics import Counters
+
+
+class ClassDistributionConsumer(scan.ScanConsumer):
+    """The lightest windowed read-out: (class value → count, fraction) of
+    the window — the summary the drift detector reasons over, exposed as a
+    consumer so jobs can publish it per window without carrying a model."""
+
+    needs_bin = False
+
+    def finalize(self, t: scan.ScanTables):
+        counts = np.asarray(t.class_counts, np.int64)
+        total = int(counts.sum())
+        return {
+            "classes": list(t.meta.class_values),
+            "counts": counts,
+            "fractions": (counts / total if total else
+                          np.zeros_like(counts, np.float64)),
+            "rows": t.rows,
+        }
+
+
+class WindowResult:
+    """One emitted window: identity, the shared tables, and every
+    consumer's finalized result (``results[name]``).  ``lines`` carries the
+    window's raw rows when the scan retains them (the retrain corpus);
+    None otherwise — including, with ``retained`` still True, for windows
+    containing panes restored from a checkpoint, whose raw rows were
+    deliberately not persisted (consumers use the flag to tell "retention
+    off" from "rows lost to a resume")."""
+
+    __slots__ = ("index", "first_pane", "last_pane", "rows", "tables",
+                 "results", "lines", "retained")
+
+    def __init__(self, index: int, first_pane: int, last_pane: int,
+                 rows: int, tables: scan.ScanTables,
+                 results: Dict[str, Any], lines: Optional[List[str]],
+                 retained: bool = False):
+        self.index = index
+        self.first_pane = first_pane
+        self.last_pane = last_pane
+        self.rows = rows
+        self.tables = tables
+        self.results = results
+        self.lines = lines
+        self.retained = retained
+
+
+def _meta_ds(enc: DatasetEncoder) -> EncodedDataset:
+    """Zero-row shape metadata in ``enc``'s code space — what ChunkFolder
+    needs to pick its routing before any pane arrives (the streaming
+    analog of ``peek_chunks``; labels present, the scan contract)."""
+    nb = len(enc.binned_fields)
+    return EncodedDataset(
+        codes=np.zeros((0, nb), np.int32),
+        cont=np.zeros((0, len(enc.cont_fields)), np.float32),
+        labels=np.zeros(0, np.int32), ids=None,
+        n_bins=np.array([enc.n_bins[f.ordinal] for f in enc.binned_fields],
+                        np.int32),
+        class_values=list(enc.class_values),
+        binned_ordinals=[f.ordinal for f in enc.binned_fields],
+        cont_ordinals=[f.ordinal for f in enc.cont_fields])
+
+
+def _pow2_buckets(pane_rows: int) -> List[int]:
+    out = [1]
+    while out[-1] < pane_rows:
+        out.append(out[-1] * 2)
+    return out
+
+
+class WindowedScan:
+    """Sliding/tumbling-window SharedScan consumer over a row stream.
+
+    ``feed(lines)`` (or ``pump(queue)``) ingests raw CSV rows; every
+    ``pane_rows`` rows close a pane (encode → pad → fold); every window
+    boundary merges the ring's pane states and finalizes the registered
+    consumers.  Returns the :class:`WindowResult` list each call emitted.
+
+    ``close_pane()`` force-closes the current pane regardless of fill —
+    the seam for time-driven panes (a wall-clock ticker calls it on the
+    period), which is also how EMPTY panes and empty windows arise.
+    ``flush()`` closes a non-empty ragged tail pane at end of stream.
+    """
+
+    def __init__(self, encoder: DatasetEncoder,
+                 consumers: Sequence[scan.ScanConsumer],
+                 pane_rows: int, window_panes: int = 1,
+                 slide_panes: Optional[int] = None, delim: str = ",",
+                 mesh=None, pad_pow2: bool = True, retain_rows: bool = False,
+                 counters: Optional[Counters] = None,
+                 checkpointer: Optional["WindowCheckpointer"] = None,
+                 crash_after_panes: int = 0, on_window=None):
+        if not encoder.schema_complete(with_labels=True) or \
+                not encoder.class_values:
+            raise ConfigError(
+                "windowed streaming requires a schema-complete encoder "
+                "(closed vocabularies, numeric ranges, class cardinality) — "
+                "a single-pass stream cannot fit a vocabulary")
+        if pane_rows < 1:
+            raise ConfigError(f"stream.pane.rows must be >= 1, got {pane_rows}")
+        if window_panes < 1:
+            raise ConfigError(
+                f"stream.window.panes must be >= 1, got {window_panes}")
+        slide = window_panes if slide_panes is None else int(slide_panes)
+        if not 1 <= slide <= window_panes:
+            raise ConfigError(
+                f"stream.slide.panes must be in [1, window.panes="
+                f"{window_panes}], got {slide}")
+        self.enc = encoder
+        self.pane_rows = int(pane_rows)
+        self.window_panes = int(window_panes)
+        self.slide_panes = slide
+        self.delim = delim
+        self.pad_pow2 = bool(pad_pow2)
+        self.retain_rows = bool(retain_rows)
+        self.counters = counters if counters is not None else Counters()
+        self.checkpointer = checkpointer
+        self.crash_after = int(crash_after_panes)
+        # invoked per window AT EMISSION — i.e. BEFORE the pane's
+        # checkpoint snapshot is written, so state the callback mutates
+        # (a drift detector attached to the checkpointer) rides the SAME
+        # snapshot and a resume replays neither side twice
+        self.on_window = on_window
+        self.meta = _meta_ds(encoder)
+        self.folder = scan.ChunkFolder(consumers, self.meta, mesh=mesh)
+        self.buckets = _pow2_buckets(self.pane_rows)
+        self._monitor = tel.CompileKeyMonitor(self.counters, group="Stream",
+                                              scope="stream.pane")
+        # the ring: the last window_panes pane records — the ONLY per-row
+        # state the scan retains, so memory is O(window), never O(stream)
+        self._ring: deque = deque(maxlen=self.window_panes)
+        self._pane_buf: List[str] = []
+        self.panes_closed = 0
+        self.windows_emitted = 0
+        self.rows_consumed = 0            # rows in CLOSED panes (resume seam)
+
+    # -- warmup ---------------------------------------------------------------
+    def warm(self) -> int:
+        """Compile every pane bucket shape on a blank fold (labels −1, so
+        nothing counts) and prime the recompile monitor; after this,
+        steady-state panes — ragged tails included — must recompile zero
+        times.  Returns the number of shapes warmed."""
+        throwaway = agg.Accumulator()
+        for bucket in self.buckets:
+            ds = self._blank_pane(bucket)
+            self._monitor.prime([tel.CompileKeyMonitor.shape_key(
+                ds.codes, ds.labels, ds.cont)])
+            self.folder.fold(ds, throwaway)
+        return len(self.buckets)
+
+    def _blank_pane(self, n: int) -> EncodedDataset:
+        m = self.meta
+        return EncodedDataset(
+            codes=np.zeros((n, m.num_binned), np.int32),
+            cont=np.zeros((n, m.num_cont), np.float32),
+            labels=np.full(n, -1, np.int32), ids=None,
+            n_bins=m.n_bins, class_values=m.class_values,
+            binned_ordinals=m.binned_ordinals, cont_ordinals=m.cont_ordinals)
+
+    # -- ingest ---------------------------------------------------------------
+    def feed(self, lines: Sequence[str]) -> List[WindowResult]:
+        """Ingest raw CSV rows; returns the windows this call completed."""
+        out: List[WindowResult] = []
+        for line in lines:
+            self._pane_buf.append(line)
+            if len(self._pane_buf) >= self.pane_rows:
+                out.extend(self.close_pane())
+        return out
+
+    def pump(self, queue, max_rows: Optional[int] = None
+             ) -> List[WindowResult]:
+        """Drain a queue transport (InProcQueue / RedisListQueue pop
+        surface) into the scan; stops at queue-empty or ``max_rows``.
+        Rows are drained first and fed as ONE batch — the buffered slice
+        is bounded by the queue's own depth cap, and the hot ingest path
+        pays one feed() call per drain instead of one per row."""
+        drained: List[str] = []
+        while max_rows is None or len(drained) < max_rows:
+            msg = queue.pop()
+            if msg is None:
+                break
+            drained.append(msg)
+        return self.feed(drained) if drained else []
+
+    def flush(self) -> List[WindowResult]:
+        """Close a non-empty ragged tail pane (end of stream)."""
+        if not self._pane_buf:
+            return []
+        return self.close_pane()
+
+    def close_pane(self) -> List[WindowResult]:
+        """Close the current pane (even empty — the time-driven tick),
+        fold it, and emit any window ending here."""
+        lines = self._pane_buf
+        self._pane_buf = []
+        acc = agg.Accumulator()
+        if lines:
+            ds = self._encode(lines)
+            ds = self._pad(ds)
+            self._monitor.observe([tel.CompileKeyMonitor.shape_key(
+                ds.codes, ds.labels, ds.cont)])
+            self.folder.fold(ds, acc)
+        self._ring.append({"pane": self.panes_closed, "rows": len(lines),
+                           "state": acc.state(),
+                           "lines": list(lines) if self.retain_rows else None})
+        self.panes_closed += 1
+        self.rows_consumed += len(lines)
+        self.counters.increment("Stream", "panes")
+        self.counters.increment("Stream", "rows", len(lines))
+        out = self._emit_windows()
+        if self.checkpointer is not None:
+            self.checkpointer.maybe_save(self)
+        if self.crash_after and self.panes_closed >= self.crash_after:
+            raise RuntimeError(
+                f"stream.fault.crash.after.panes={self.crash_after}: "
+                f"injected crash after pane {self.panes_closed - 1}")
+        return out
+
+    def _encode(self, lines: List[str]) -> EncodedDataset:
+        rows = read_csv_string("\n".join(lines), delim=self.delim)
+        return self.enc.transform(rows, with_labels=True)
+
+    def _pad(self, ds: EncodedDataset) -> EncodedDataset:
+        """Pad the pane to its power-of-two row bucket with label −1 rows:
+        out-of-range labels drop out of EVERY count table (both gram and
+        einsum paths share the drop-invalid contract), so the pad is pure
+        shape ballast and the compiled-shape set stays finite."""
+        if not self.pad_pow2:
+            return ds
+        target = next(b for b in self.buckets if b >= ds.num_rows)
+        pad = target - ds.num_rows
+        if pad == 0:
+            return ds
+        return EncodedDataset(
+            codes=np.pad(ds.codes, ((0, pad), (0, 0))),
+            cont=np.pad(ds.cont, ((0, pad), (0, 0))),
+            labels=np.pad(ds.labels, (0, pad), constant_values=-1),
+            ids=None, n_bins=ds.n_bins, class_values=ds.class_values,
+            binned_ordinals=ds.binned_ordinals,
+            cont_ordinals=ds.cont_ordinals)
+
+    # -- window emission ------------------------------------------------------
+    def _emit_windows(self) -> List[WindowResult]:
+        if self.panes_closed < self.window_panes or \
+                (self.panes_closed - self.window_panes) % self.slide_panes:
+            return []
+        merged = agg.Accumulator()
+        rows = 0
+        lines: Optional[List[str]] = [] if self.retain_rows else None
+        for rec in self._ring:
+            for key, val in rec["state"].items():
+                merged.add(key, val)
+            rows += rec["rows"]
+            if lines is not None:
+                if rec["lines"] is None:
+                    lines = None          # restored pane: rows not retained
+                else:
+                    lines.extend(rec["lines"])
+        tables = self.folder.tables(merged, rows)
+        results = {c.name: c.finalize(tables) for c in self.folder.consumers}
+        window = WindowResult(
+            index=self.windows_emitted,
+            first_pane=self.panes_closed - self.window_panes,
+            last_pane=self.panes_closed - 1,
+            rows=rows, tables=tables, results=results, lines=lines,
+            retained=self.retain_rows)
+        self.windows_emitted += 1
+        self.counters.increment("Stream", "windows")
+        if self.on_window is not None:
+            self.on_window(window)
+        return [window]
+
+    # -- checkpointable state -------------------------------------------------
+    def state(self) -> dict:
+        """The windowed accumulator ring + progress cursors — everything a
+        resumed scan needs to reproduce the remaining windows byte-for-byte
+        when re-fed from row ``rows_consumed``.  Raw retained lines are NOT
+        persisted (they exist for retraining, not correctness); the open
+        pane's buffered rows are NOT persisted either — the cursor points
+        at the last closed pane boundary, so a resume re-feeds them."""
+        return {
+            "pane": self.panes_closed,
+            "windows": self.windows_emitted,
+            "rows_consumed": self.rows_consumed,
+            "ring": [{"pane": rec["pane"], "rows": rec["rows"],
+                      "state": dict(rec["state"])} for rec in self._ring],
+        }
+
+    def load(self, state: dict) -> None:
+        self.panes_closed = int(state["pane"])
+        self.windows_emitted = int(state["windows"])
+        self.rows_consumed = int(state["rows_consumed"])
+        self._ring.clear()
+        for rec in state["ring"]:
+            self._ring.append({"pane": int(rec["pane"]),
+                               "rows": int(rec["rows"]),
+                               "state": {k: np.asarray(v)
+                                         for k, v in rec["state"].items()},
+                               "lines": None})
+        self._pane_buf = []
+
+
+class WindowCheckpointer:
+    """Mid-stream durability for the windowed ring — the StreamCheckpointer
+    discipline applied to pane-granular state.
+
+    Snapshots (every ``stream.checkpoint.interval.panes`` closed panes) hold
+    the ring + cursors under the SAME conf-derived run fingerprint the
+    streaming jobs use (``StreamCheckpointer.run_id_from_conf`` — GL002:
+    accumulator state never persists without its configuration identity);
+    restore rejects a snapshot written by a different configuration loudly.
+    A resumed scan re-fed from row ``rows_consumed`` reproduces the
+    remaining windows byte-for-byte (tests/test_stream.py kill-and-resume).
+    """
+
+    def __init__(self, directory: str, run_id: str = "",
+                 interval_panes: int = 8, resume: bool = False):
+        from avenir_tpu.utils.checkpoint import CheckpointManager
+
+        self.directory = directory
+        self.run_id = run_id
+        self.interval = max(int(interval_panes), 1)
+        self.mgr = CheckpointManager(directory, keep=2)
+        self._components: Dict[str, Any] = {}
+        self.restored: Optional[dict] = None
+        if resume:
+            state = self.mgr.restore()
+            if state is not None:
+                snap_run = str(state.get("run", ""))
+                if snap_run and run_id and snap_run != run_id:
+                    raise ConfigError(
+                        f"stream snapshot in {directory!r} was written by "
+                        f"run {snap_run!r}, not this run {run_id!r} — the "
+                        f"configuration changed since the checkpoint; clear "
+                        f"the directory and restart the stream")
+                self.restored = state
+
+    @classmethod
+    def from_conf(cls, conf: JobConfig) -> Optional["WindowCheckpointer"]:
+        from avenir_tpu.jobs.base import StreamCheckpointer
+
+        directory = conf.get("stream.checkpoint.dir")
+        if not directory:
+            return None
+        return cls(
+            directory,
+            run_id=StreamCheckpointer.run_id_from_conf(conf),
+            interval_panes=conf.get_int("stream.checkpoint.interval.panes", 8),
+            resume=conf.get_bool("stream.resume", False))
+
+    def attach(self, key: str, component) -> None:
+        """Register a sidecar whose ``state()``/``load()`` rides the ring
+        snapshot (the drift detector: its reference window and streak must
+        resume WITH the windows, or a resumed run's drift sequence would
+        diverge from an uninterrupted one).  Attach before
+        :meth:`restore_into`."""
+        self._components[key] = component
+
+    def restore_into(self, ws: WindowedScan) -> int:
+        """Load the restored snapshot (if any) into ``ws`` and every
+        attached component; returns the row cursor the caller must re-feed
+        from (0 on a fresh start)."""
+        if self.restored is None:
+            return 0
+        ws.load(self.restored)
+        extras = self.restored.get("extras") or {}
+        for key, component in self._components.items():
+            if key in extras:
+                component.load(extras[key])
+        tel.tracer().event("checkpoint.restore", dir=self.directory,
+                           run=self.run_id, rows=ws.rows_consumed,
+                           chunk=ws.panes_closed)
+        return ws.rows_consumed
+
+    def maybe_save(self, ws: WindowedScan) -> None:
+        if ws.panes_closed and ws.panes_closed % self.interval == 0:
+            self.save(ws)
+
+    def save(self, ws: WindowedScan) -> None:
+        # "run" fingerprints the writing configuration (GL002): restore
+        # rejects a snapshot whose run id differs
+        state = ws.state()
+        state["run"] = self.run_id
+        if self._components:
+            state["extras"] = {key: component.state()
+                               for key, component in self._components.items()}
+        self.mgr.save(ws.panes_closed, state)
+        tel.tracer().event("checkpoint.save", dir=self.directory,
+                           run=self.run_id, rows=ws.rows_consumed,
+                           chunk=ws.panes_closed)
+
+    def finish(self) -> None:
+        """Remove the snapshots after a cleanly completed stream (the
+        manager also removes the then-empty directory)."""
+        self.mgr.clear()

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""StreamGraft soak benchmark: sustained windowed-analytics throughput and
+the drift→retrain→hot-swap latency, with the zero-steady-state-recompiles
+invariant ASSERTED.
+
+Measures, on one synthetic stream (categorical + continuous features,
+class-conditional structure):
+
+- ``events_per_sec``: rows/sec through the full windowed path (queue pop →
+  parse → encode → pow-2 pad → fused gram+moments fold → ring merge →
+  consumer finalize) at steady state;
+- ``pane_fold_ms`` p50/p99: latency of one pane close (the per-micro-batch
+  cost a live stream pays);
+- ``drift_to_swap_ms``: wall time from the FIRST drifted row entering the
+  scan to the retrained model published in the serving registry (detection
+  lag across the hysteresis windows + batch refit + swap barrier);
+- ``steady_state_recompiles_total``: the CompileKeyMonitor count after
+  warmup — ragged tail panes MUST land on pre-warmed pow-2 bucket shapes;
+  nonzero raises RuntimeError (survives ``python -O``; the invariant IS
+  the measurement).
+
+One JSON line on stdout; a fresh matmul canary rides in the artifact per
+the PR-2 convention (a loaded rig indicts itself, not the stream).
+"""
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["r", "g", "b"], "feature": True},
+        {"name": "size", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["s", "m", "l"], "feature": True},
+        {"name": "score", "ordinal": 3, "dataType": "double",
+         "feature": True},
+        {"name": "status", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["pos", "neg"]},
+    ]
+}
+
+PANE_ROWS = 256
+WINDOW_PANES = 4
+STEADY_PANES = 24
+DRIFTED_PANES = 12
+
+
+def gen_lines(n, seed, flip=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        color = ["r", "g", "b"][int(rng.integers(0, 3))]
+        size = ["s", "m", "l"][int(rng.integers(0, 3))]
+        score = (8 + int(rng.integers(0, 17))) / 16.0 + \
+            (1.0 if color == "r" else 0.0)
+        p_pos = 0.9 if color == "r" else 0.15
+        if flip:
+            p_pos = 1.0 - p_pos
+        status = "pos" if rng.random() < p_pos else "neg"
+        out.append(f"id{i},{color},{size},{score!r},{status}")
+    return out
+
+
+def main():
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.pipeline import scan
+    from avenir_tpu.pipeline.streaming import InProcQueue
+    from avenir_tpu.serving import BucketedMicrobatcher, ModelRegistry
+    from avenir_tpu.stream import (
+        ClassDistributionConsumer,
+        DriftDetector,
+        DriftRetrainController,
+        WindowedScan,
+    )
+    from avenir_tpu.utils.metrics import LatencyTracker
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
+
+    root = tempfile.mkdtemp(prefix="streaming_soak_")
+    schema_path = os.path.join(root, "schema.json")
+    with open(schema_path, "w") as fh:
+        fh.write(json.dumps(SCHEMA))
+    train_path = os.path.join(root, "train.csv")
+    with open(train_path, "w") as fh:
+        fh.write("\n".join(gen_lines(4096, seed=7)) + "\n")
+    conf = JobConfig({
+        "feature.schema.file.path": schema_path,
+        "bayesian.model.file.path": os.path.join(root, "nb_model"),
+        "serve.models": "naiveBayes",
+        "serve.bucket.sizes": "1,2,4,8",
+        "stream.retrain.dir": os.path.join(root, "retrain"),
+    })
+    get_job("BayesianDistribution").run(conf, train_path,
+                                        os.path.join(root, "nb_model"))
+    registry = ModelRegistry.from_conf(conf)
+    batcher = BucketedMicrobatcher.from_conf(registry, conf)
+    enc = DatasetEncoder(FeatureSchema.from_file(schema_path))
+    detector = DriftDetector(threshold=0.01, min_windows=2, source="class")
+    controller = DriftRetrainController(conf, batcher, detector)
+    ws = WindowedScan(
+        enc,
+        [ClassDistributionConsumer(name="cd"),
+         scan.NaiveBayesConsumer(name="nb"),
+         scan.MutualInfoConsumer(name="mi")],
+        pane_rows=PANE_ROWS, window_panes=WINDOW_PANES, slide_panes=1,
+        retain_rows=True)
+    ws.warm()
+
+    canary_ms = matmul_canary_ms()
+
+    # -- steady-state soak: rows/sec + per-pane fold latency ------------------
+    steady = gen_lines(STEADY_PANES * PANE_ROWS, seed=11)
+    queue = InProcQueue(depth=4 * PANE_ROWS)
+    pane_lat = LatencyTracker()
+    windows = []
+    t0 = time.perf_counter()
+    for start in range(0, len(steady), PANE_ROWS):
+        for line in steady[start:start + PANE_ROWS]:
+            queue.push(line)
+        t_pane = time.perf_counter()
+        windows.extend(ws.pump(queue))
+        pane_lat.record(time.perf_counter() - t_pane)
+    steady_s = time.perf_counter() - t0
+    for window in windows:
+        controller.on_window(window)
+    if controller.swaps:
+        raise RuntimeError("steady-state traffic must not trip a retrain")
+
+    # -- drift injection: first drifted row → swapped model -------------------
+    drifted = gen_lines(DRIFTED_PANES * PANE_ROWS, seed=13, flip=True)
+    # an off-pane-size tail exercises the ragged pow-2 bucket path
+    drifted = drifted[:-(PANE_ROWS // 3)]
+    t_drift = time.perf_counter()
+    drift_to_swap_ms = None
+    for start in range(0, len(drifted), PANE_ROWS):
+        for window in ws.feed(drifted[start:start + PANE_ROWS]):
+            if controller.on_window(window) is not None and \
+                    drift_to_swap_ms is None:
+                drift_to_swap_ms = (time.perf_counter() - t_drift) * 1e3
+    for window in ws.flush():
+        controller.on_window(window)
+    if drift_to_swap_ms is None:
+        raise RuntimeError("injected distribution shift never tripped the "
+                           "drift→retrain→swap loop")
+    if registry.version("naiveBayes") < 2:
+        raise RuntimeError("retrain completed but the registry version "
+                           "never advanced")
+    batcher.close()
+
+    recompiles = int(ws.counters.get("Stream", "recompiles") or 0)
+    if recompiles != 0:
+        raise RuntimeError(
+            f"steady_state_recompiles_total={recompiles}: a pane shape "
+            f"missed the pre-warmed pow-2 buckets")
+    stats = pane_lat.snapshot()
+    print(json.dumps({
+        "benchmark": "streaming_soak",
+        "canary_ms": round(canary_ms, 3),
+        "pane_rows": PANE_ROWS,
+        "window_panes": WINDOW_PANES,
+        "rows_steady": len(steady),
+        "windows_emitted": ws.windows_emitted,
+        "events_per_sec": round(len(steady) / steady_s, 1),
+        "pane_fold_ms_p50": round(stats["p50_ms"], 3),
+        "pane_fold_ms_p99": round(stats["p99_ms"], 3),
+        "drift_to_swap_ms": round(drift_to_swap_ms, 1),
+        "retrain_fit_swap_ms": round(controller.last_swap_s * 1e3, 1),
+        "model_version": registry.version("naiveBayes"),
+        "steady_state_recompiles_total": recompiles,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -358,10 +358,14 @@ def test_whole_tree_zero_nonbaselined_findings():
     # traced pipelines end-to-end, where an undocumented trace.* key or a
     # sync-in-loop would hide (avenir_tpu/telemetry/ itself is inside the
     # avenir_tpu tree the gate already walks)
+    # tests/test_stream.py likewise (round 11) — stream tests drive the
+    # windowed fold + checkpoint + drift→swap loops, where an undocumented
+    # stream.* key (GL004) or unfingerprinted snapshot (GL002) would hide
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
-         str(REPO / "tests" / "test_telemetry.py")],
+         str(REPO / "tests" / "test_telemetry.py"),
+         str(REPO / "tests" / "test_stream.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

@@ -1,0 +1,714 @@
+"""StreamGraft (avenir_tpu/stream) — windowed streaming analytics.
+
+The heart is the fused-window == batch-replay oracle: every window a
+WindowedScan emits must be BYTE-IDENTICAL, per consumer, to a batch
+SharedScan over exactly that window's rows — tumbling and sliding
+(overlapping pane-merge) alike, with and without pow-2 pane padding.
+Around it: pane/window boundary semantics (a row landing exactly on a pane
+edge, ragged tails, empty windows), the bounded in-proc queue's typed
+backpressure, zero steady-state recompiles after warmup, checkpoint
+kill-and-resume byte-identity, drift-detector hysteresis, and the
+end-to-end drift → retrain → hot-swap loop (journal events, registry
+versioning, in-flight requests finishing on the old params).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import ConfigError, JobConfig
+from avenir_tpu.core.csv_io import read_csv_string
+from avenir_tpu.core.encoding import DatasetEncoder
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.jobs import get_job
+from avenir_tpu.pipeline import scan
+from avenir_tpu.pipeline.streaming import InProcQueue, QueueFullError
+from avenir_tpu.stream import (
+    ClassDistributionConsumer,
+    DriftDetector,
+    DriftRetrainController,
+    WindowCheckpointer,
+    WindowedScan,
+)
+from avenir_tpu.telemetry import spans as tel
+from avenir_tpu.telemetry.journal import read_events
+
+
+# ---------------------------------------------------------------------------
+# stream fixture: a schema with binned AND continuous features
+# ---------------------------------------------------------------------------
+
+STREAM_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["r", "g", "b"], "feature": True},
+        {"name": "size", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["s", "m", "l"], "feature": True},
+        {"name": "score", "ordinal": 3, "dataType": "double",
+         "feature": True},
+        {"name": "status", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["pos", "neg"]},
+    ]
+}
+
+
+def gen_lines(n, seed, flip=False):
+    """CSV rows with P(status|color) steady or FLIPPED (the drift signal).
+    Scores live on the 1/16 grid in [0.5, 2.5]: every value AND square is
+    exactly representable in float32 and their partial sums stay exact, so
+    moment byte-identity across any pane chunking/padding is mathematically
+    guaranteed, not rounding luck."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        color = ["r", "g", "b"][int(rng.integers(0, 3))]
+        size = ["s", "m", "l"][int(rng.integers(0, 3))]
+        score = (8 + int(rng.integers(0, 17))) / 16.0 + \
+            (1.0 if color == "r" else 0.0)
+        p_pos = 0.9 if color == "r" else 0.15
+        if flip:
+            p_pos = 1.0 - p_pos
+        status = "pos" if rng.random() < p_pos else "neg"
+        out.append(f"id{i},{color},{size},{score!r},{status}")
+    return out
+
+
+@pytest.fixture(scope="module")
+def ws_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("streamgraft")
+    schema_path = str(root / "stream.json")
+    (root / "stream.json").write_text(json.dumps(STREAM_SCHEMA))
+    return {"root": root, "schema": schema_path,
+            "enc": lambda: DatasetEncoder(
+                FeatureSchema.from_file(schema_path))}
+
+
+def consumers():
+    return [ClassDistributionConsumer(name="cd"),
+            scan.NaiveBayesConsumer(name="nb"),
+            scan.MutualInfoConsumer(name="mi"),
+            scan.CorrelationConsumer(name="cramer", against_class=True)]
+
+
+def batch_oracle(enc, lines):
+    """The acceptance oracle: a plain batch SharedScan over exactly these
+    rows, through the standalone engine entry the pipelines use."""
+    eng = scan.SharedScan()
+    for c in consumers():
+        eng.register(c)
+    return eng.run(enc.transform(read_csv_string("\n".join(lines)),
+                                 with_labels=True))
+
+
+def assert_window_matches_batch(window, enc):
+    assert window.lines, "oracle comparison needs retained rows"
+    batch = batch_oracle(enc, window.lines)
+    np.testing.assert_array_equal(window.results["cd"]["counts"],
+                                  batch["cd"]["counts"])
+    for attr in ("bin_counts", "class_counts", "cont_count", "cont_sum",
+                 "cont_sumsq"):
+        np.testing.assert_array_equal(getattr(window.results["nb"], attr),
+                                      getattr(batch["nb"], attr))
+    assert window.results["mi"].to_lines() == batch["mi"].to_lines()
+    np.testing.assert_array_equal(window.results["cramer"].contingency,
+                                  batch["cramer"].contingency)
+    np.testing.assert_array_equal(window.results["cramer"].stat,
+                                  batch["cramer"].stat)
+
+
+# ---------------------------------------------------------------------------
+# window semantics: fused-window == batch-replay, boundaries, empties
+# ---------------------------------------------------------------------------
+
+def test_tumbling_windows_byte_identical_to_batch(ws_root):
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, consumers(), pane_rows=50, window_panes=2,
+                      retain_rows=True)
+    lines = gen_lines(370, seed=3)
+    windows = ws.feed(lines) + ws.flush()
+    # 370 rows / 50 = 7 full panes + ragged 20 → 8 panes → 4 windows
+    assert ws.panes_closed == 8 and len(windows) == 4
+    assert windows[-1].rows == 70             # 50 + the 20-row ragged pane
+    for w in windows:
+        assert w.lines == lines[w.first_pane * 50:
+                                w.first_pane * 50 + w.rows]
+        assert_window_matches_batch(w, enc)
+
+
+@pytest.mark.parametrize("pad_pow2", [True, False])
+def test_sliding_windows_overlap_byte_identical_to_batch(ws_root, pad_pow2):
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, consumers(), pane_rows=40, window_panes=3,
+                      slide_panes=1, retain_rows=True, pad_pow2=pad_pow2)
+    lines = gen_lines(240, seed=5)
+    windows = ws.feed(lines)
+    # 6 panes, window=3 slide=1 → windows end at panes 2,3,4,5
+    assert [w.last_pane for w in windows] == [2, 3, 4, 5]
+    assert all(w.rows == 120 for w in windows)
+    for w in windows:
+        assert_window_matches_batch(w, enc)
+    # overlap really overlaps: consecutive windows share 2 panes of rows
+    assert windows[0].lines[40:] == windows[1].lines[:80]
+
+
+def test_pane_edge_and_ragged_tail(ws_root):
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, consumers(), pane_rows=32, window_panes=1,
+                      retain_rows=True)
+    lines = gen_lines(64, seed=7)
+    # rows landing exactly on the pane edge: no ragged tail to flush
+    windows = ws.feed(lines)
+    assert ws.panes_closed == 2 and len(windows) == 2
+    assert ws.flush() == []
+    # one more row makes a 1-row ragged pane, closed only by flush
+    ws.feed(lines[:1])
+    assert ws.panes_closed == 2
+    tail = ws.flush()
+    assert len(tail) == 1 and tail[0].rows == 1
+    assert_window_matches_batch(tail[0], enc)
+
+
+def test_feed_chunking_invariance(ws_root):
+    """Windows depend only on row ORDER, never on arrival batching."""
+    enc = ws_root["enc"]()
+    lines = gen_lines(200, seed=11)
+    one = WindowedScan(enc, consumers(), 30, window_panes=2, slide_panes=1,
+                       retain_rows=True)
+    all_at_once = one.feed(lines) + one.flush()
+    dribble = WindowedScan(enc, consumers(), 30, window_panes=2,
+                           slide_panes=1, retain_rows=True)
+    trickled = []
+    for i in range(0, len(lines), 17):
+        trickled += dribble.feed(lines[i:i + 17])
+    trickled += dribble.flush()
+    assert [w.last_pane for w in all_at_once] == \
+        [w.last_pane for w in trickled]
+    for a, b in zip(all_at_once, trickled):
+        assert a.lines == b.lines
+        np.testing.assert_array_equal(a.results["cd"]["counts"],
+                                      b.results["cd"]["counts"])
+
+
+def test_empty_windows_finalize(ws_root):
+    """Time-driven ticks can close empty panes; a fully-empty window still
+    finalizes every consumer deterministically (zero tables)."""
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, consumers(), pane_rows=16, window_panes=2)
+    assert ws.close_pane() == []
+    (window,) = ws.close_pane()
+    assert window.rows == 0
+    assert int(window.results["cd"]["counts"].sum()) == 0
+    assert window.results["cd"]["fractions"].tolist() == [0.0, 0.0]
+    assert window.results["nb"].class_counts.tolist() == [0.0, 0.0]
+    detector = DriftDetector(threshold=0.1)
+    detector.last_divergence = 0.231           # a prior window's score
+    assert detector.update(window) is None     # no rows = no evidence
+    assert detector.last_divergence == 0.0, \
+        "an empty window must not republish the previous window's score"
+
+
+def test_zero_recompiles_after_warm(ws_root):
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, consumers(), pane_rows=32, window_panes=1)
+    warmed = ws.warm()
+    assert warmed == len(ws.buckets) == 6      # 1,2,4,8,16,32
+    ws.feed(gen_lines(100, seed=13))           # 3 full panes + 4-row tail
+    ws.flush()
+    assert not ws.counters.get("Stream", "recompiles"), \
+        "steady-state pane folds must hit pre-warmed pow-2 shapes"
+
+
+# ---------------------------------------------------------------------------
+# bounded queue + pump
+# ---------------------------------------------------------------------------
+
+def test_inproc_queue_cap_and_drain():
+    q = InProcQueue(depth=4)
+    for i in range(4):
+        q.push(f"m{i}")
+    with pytest.raises(QueueFullError):
+        q.push("overflow")
+    assert len(q) == 4                         # rejected push not enqueued
+    assert q.drain() == ["m0", "m1", "m2", "m3"]
+    q.push("again")                            # space reclaimed
+    assert q.pop() == "again"
+    under = InProcQueue(depth=8)
+    for i in range(3):
+        under.push(f"u{i}")
+    assert under.drain() == ["u0", "u1", "u2"]
+
+
+def test_action_writer_all_or_nothing_on_bounded_queue():
+    """A multi-action selection against a nearly-full bounded queue must
+    publish ALL of its actions or none: the RL serving loop's shed path
+    counts the whole event's actions as dropped on QueueFullError, so a
+    partial set would be a silent half-publish the consumer can't detect."""
+    from avenir_tpu.pipeline import streaming as st
+
+    q = InProcQueue(depth=4)
+    writer = st.QueueActionWriter(q)
+    writer.write("ev0", ["a", "b", "c"])
+    with pytest.raises(QueueFullError):
+        writer.write("ev1", ["d", "e"])        # only one slot free
+    assert q.drain() == ["ev0,a", "ev0,b", "ev0,c"]   # no partial ev1
+    writer.write("ev2", ["f", "g"])            # space reclaimed
+    assert q.drain() == ["ev2,f", "ev2,g"]
+
+
+def test_rl_serving_loop_sheds_on_bounded_action_queue():
+    """The round-11 queue cap must not kill a long-lived RL serving loop
+    whose action consumer lags: the write is SHED (counted) and the loop
+    keeps serving — the deployed ``replay.failed.message=false`` drop
+    semantics, not a worker death and not unbounded growth."""
+    from avenir_tpu.models import online_rl as orl
+    from avenir_tpu.pipeline import streaming as st
+
+    events = st.InProcQueue()
+    actions = st.InProcQueue(depth=2)          # nobody drains it
+    learner = orl.create_learner("intervalEstimator", ["a", "b"],
+                                 {"min.reward.distr.sample": 5}, seed=3)
+    server = st.ReinforcementLearnerServer(
+        learner, st.QueueEventSource(events),
+        st.QueueRewardReader(st.InProcQueue()),
+        st.QueueActionWriter(actions))
+    for i in range(6):
+        events.push(f"ev{i},{i}")
+    assert server.run() == 6                   # every event still served
+    assert len(actions) == 2                   # backlog capped, not grown
+    assert server.counters.get("Serving.rl", "shed") == 4
+
+
+def test_pump_from_queue(ws_root):
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, consumers(), pane_rows=25, window_panes=1,
+                      retain_rows=True)
+    q = InProcQueue(depth=256)
+    lines = gen_lines(60, seed=17)
+    for line in lines:
+        q.push(line)
+    windows = ws.pump(q, max_rows=50)
+    assert len(q) == 10 and len(windows) == 2
+    windows += ws.pump(q) + ws.flush()
+    assert len(windows) == 3
+    assert [w.rows for w in windows] == [25, 25, 10]
+    for w in windows:
+        assert_window_matches_batch(w, enc)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / kill-and-resume
+# ---------------------------------------------------------------------------
+
+def _ckpt_conf(ws_root, tmp_path, **extra):
+    props = {"feature.schema.file.path": ws_root["schema"],
+             "stream.pane.rows": "16",
+             "stream.checkpoint.dir": str(tmp_path / "ckpt"),
+             "stream.checkpoint.interval.panes": "2"}
+    props.update(extra)
+    return JobConfig(props)
+
+
+def test_window_checkpoint_kill_and_resume_byte_identical(ws_root, tmp_path):
+    enc = ws_root["enc"]()
+    lines = gen_lines(128, seed=19)            # exactly 8 panes of 16
+    mk = lambda **kw: WindowedScan(enc, consumers(), 16, window_panes=3,
+                                   slide_panes=1, **kw)
+    golden = mk()
+    uninterrupted = golden.feed(lines)
+
+    conf = _ckpt_conf(ws_root, tmp_path)
+    crashed = mk(checkpointer=WindowCheckpointer.from_conf(conf),
+                 crash_after_panes=5)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        crashed.feed(lines)
+
+    resumed_ckpt = WindowCheckpointer.from_conf(
+        _ckpt_conf(ws_root, tmp_path, **{"stream.resume": "true"}))
+    resumed = mk(checkpointer=resumed_ckpt)
+    skip = resumed_ckpt.restore_into(resumed)
+    assert skip == 64 and resumed.panes_closed == 4   # snapshot at pane 4
+    replayed = resumed.feed(lines[skip:])
+    # the resumed stream reproduces windows 2..5 byte-for-byte
+    assert [w.index for w in replayed] == [2, 3, 4, 5]
+    by_index = {w.index: w for w in uninterrupted}
+    for w in replayed:
+        ref = by_index[w.index]
+        assert (w.first_pane, w.last_pane, w.rows) == \
+            (ref.first_pane, ref.last_pane, ref.rows)
+        np.testing.assert_array_equal(w.results["cd"]["counts"],
+                                      ref.results["cd"]["counts"])
+        for attr in ("bin_counts", "class_counts", "cont_sum",
+                     "cont_sumsq"):
+            np.testing.assert_array_equal(getattr(w.results["nb"], attr),
+                                          getattr(ref.results["nb"], attr))
+        assert w.results["mi"].to_lines() == ref.results["mi"].to_lines()
+    resumed_ckpt.finish()
+
+
+def test_checkpoint_run_id_mismatch_refused(ws_root, tmp_path):
+    enc = ws_root["enc"]()
+    conf = _ckpt_conf(ws_root, tmp_path)
+    ckpt = WindowCheckpointer.from_conf(conf)
+    ws = WindowedScan(enc, consumers(), 16, window_panes=2,
+                      checkpointer=ckpt)
+    ws.feed(gen_lines(32, seed=23))            # 2 panes → snapshot written
+    # a DIFFERENT configuration (pane size changed) must refuse the
+    # snapshot loudly — the cursor means different chunk boundaries
+    other = _ckpt_conf(ws_root, tmp_path,
+                       **{"stream.pane.rows": "32", "stream.resume": "true"})
+    with pytest.raises(ConfigError, match="written by"):
+        WindowCheckpointer.from_conf(other)
+
+
+def test_stream_analytics_job_output_and_resume(ws_root, tmp_path):
+    lines = gen_lines(96, seed=29)             # 6 panes of 16 → 3 windows
+    data = tmp_path / "data.csv"
+    data.write_text("\n".join(lines) + "\n")
+    props = {"feature.schema.file.path": ws_root["schema"],
+             "stream.pane.rows": "16", "stream.window.panes": "2",
+             "stream.consumers": "classDistribution,naiveBayes",
+             # drift ON: the detector's reference/streak ride the ring
+             # snapshot, so the resumed run's drift lines must match too
+             "stream.drift.threshold": "0.05",
+             "stream.checkpoint.dir": str(tmp_path / "jckpt"),
+             "stream.checkpoint.interval.panes": "2"}
+    golden = get_job("StreamAnalytics").run(
+        JobConfig(dict(props)), str(data), str(tmp_path / "out_a"))
+    out_a = (tmp_path / "out_a" / "part-00000").read_text().splitlines()
+    assert golden.get("Stream", "windows") == 3
+    assert golden.get("Records", "Processed") == 96
+    assert out_a[0] == "w=0,panes=0-1,rows=32"
+
+    # a failed run publishes NO artifact (the part file streams to a
+    # sibling .inprogress, renamed only on clean completion): the driver's
+    # resume-skip tests os.path.exists(output), so a partial output dir
+    # would read as a completed stage
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_job("StreamAnalytics").run(
+            JobConfig({**props, "stream.fault.crash.after.panes": "5"}),
+            str(data), str(tmp_path / "out_b"))
+    assert not (tmp_path / "out_b").exists()
+    resumed = get_job("StreamAnalytics").run(
+        JobConfig({**props, "stream.resume": "true"}),
+        str(data), str(tmp_path / "out_c"))
+    out_c = (tmp_path / "out_c" / "part-00000").read_text().splitlines()
+    # restored at pane 4: the resumed run re-emits exactly window 2, and
+    # its lines are byte-identical to the uninterrupted run's tail
+    assert resumed.get("Stream", "windows") == 1
+    w2 = next(i for i, ln in enumerate(out_a) if ln.startswith("w=2,panes"))
+    assert out_c == out_a[w2:]
+    assert not (tmp_path / "jckpt").exists()   # clean finish swept snapshots
+
+    # an output path under a not-yet-existing parent works like every
+    # batch job's (the .inprogress sibling creates its parent dirs)
+    nested = {k: v for k, v in props.items()
+              if not k.startswith("stream.checkpoint")}
+    get_job("StreamAnalytics").run(
+        JobConfig(dict(nested)), str(data), str(tmp_path / "deep" / "out"))
+    assert (tmp_path / "deep" / "out" / "part-00000").read_text() \
+        .splitlines() == out_a
+
+    # a config error on a re-run never truncates the previous good
+    # artifact: validation precedes any output-side file touch
+    bad = {k: v for k, v in props.items() if not k.startswith("stream.checkpoint")}
+    with pytest.raises(ConfigError, match="unknown stream consumer"):
+        get_job("StreamAnalytics").run(
+            JobConfig({**bad, "stream.consumers": "naiveBays"}),
+            str(data), str(tmp_path / "out_a"))
+    assert (tmp_path / "out_a" / "part-00000").read_text().splitlines() \
+        == out_a
+
+
+# ---------------------------------------------------------------------------
+# drift detection
+# ---------------------------------------------------------------------------
+
+def _const_lines(n, color, status, start=0):
+    return [f"id{start + i},{color},m,1.25,{status}" for i in range(n)]
+
+
+def test_drift_detector_hysteresis_and_rebase(ws_root):
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, [ClassDistributionConsumer(name="cd")],
+                      pane_rows=8, window_panes=1)
+    detector = DriftDetector(threshold=0.1, min_windows=2, source="class")
+    fires = []
+    for status in ("pos", "pos", "neg", "neg", "neg"):
+        (window,) = ws.feed(_const_lines(8, "r", status))
+        fires.append(detector.update(window) is not None)
+    # w0 = reference, w1 steady, w2 first drifted (streak 1 — hysteresis
+    # holds), w3 sustained → FIRE, w4 drifted-but-rebased → steady again
+    assert fires == [False, False, False, True, False]
+    assert detector.fired == 1 and detector.streak == 0
+    assert detector.last_divergence == 0.0     # w4 scored vs new reference
+
+
+def test_uncommitted_fire_refires_until_committed(ws_root):
+    """The controller contract: a firing scored with commit=False (its
+    retrain deferred/failed) leaves the reference un-rebased, so a
+    one-time step change KEEPS firing until commit_fire consumes it."""
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, [ClassDistributionConsumer(name="cd")],
+                      pane_rows=8, window_panes=1)
+    detector = DriftDetector(threshold=0.1, min_windows=1, source="class")
+    (ref,) = ws.feed(_const_lines(8, "r", "pos"))
+    assert detector.update(ref) is None        # reference
+    (w1,) = ws.feed(_const_lines(8, "r", "neg"))
+    assert detector.update(w1, commit=False) is not None
+    (w2,) = ws.feed(_const_lines(8, "r", "neg"))
+    assert detector.update(w2, commit=False) is not None   # re-fires
+    detector.commit_fire(w2.tables)            # retrain finally landed
+
+
+def test_retrain_failure_shed_not_fatal(ws_root, tmp_path, monkeypatch):
+    """A transient retrain/load/swap failure is SHED (counted), the stream
+    keeps analyzing, and the unconsumed firing re-fires on the next
+    drifted window — landing the swap once the fault clears — instead of
+    one bad fit killing the whole live analytics plane."""
+    import types
+
+    class _Reg:
+        def get(self, name):
+            return types.SimpleNamespace(family="naiveBayes")
+
+    conf = JobConfig({"stream.retrain.dir": str(tmp_path / "rt")})
+    detector = DriftDetector(threshold=0.05, min_windows=1, source="class")
+    controller = DriftRetrainController(
+        conf, types.SimpleNamespace(registry=_Reg()), detector)
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, [ClassDistributionConsumer(name="cd")],
+                      pane_rows=8, window_panes=1, retain_rows=True)
+    (ref,) = ws.feed(_const_lines(8, "r", "pos"))
+    assert controller.on_window(ref) is None           # reference window
+
+    def boom(window, event):
+        raise OSError("no space left on device")
+    monkeypatch.setattr(controller, "retrain_and_swap", boom)
+    (w1,) = ws.feed(_const_lines(8, "r", "neg"))
+    assert controller.on_window(w1) is None            # shed, not raised
+    assert controller.counters.get("Stream", "retrain.failed") == 1
+    assert detector.streak == 1                        # firing unconsumed
+
+    monkeypatch.setattr(controller, "retrain_and_swap",
+                        lambda window, event: 7)       # fault cleared
+    (w2,) = ws.feed(_const_lines(8, "r", "neg"))
+    assert controller.on_window(w2) == 7               # re-fired and landed
+    assert detector.streak == 0                        # firing consumed
+    (w3,) = ws.feed(_const_lines(8, "r", "neg"))
+    assert detector.update(w3) is None         # new normal
+
+
+def test_chisquare_unseen_category_is_bounded(ws_root):
+    """A category absent from the reference window must read as moderate
+    chi-square divergence (smoothed), not an ε-denominator blow-up that
+    fires on one rare row."""
+    from avenir_tpu.stream.drift import chisquare_divergence
+
+    d = chisquare_divergence(np.array([0.99, 0.01]), np.array([1.0, 0.0]))
+    assert 0.0 < d < 1.0
+
+
+def test_drift_source_features_without_count_consumer_refused(ws_root):
+    """source=features with no consumer aggregating the [F,B,C] table
+    must refuse loudly — a silent 0.0-forever detector is worse than none
+    (source=both degrades to class-only by documented design)."""
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, [ClassDistributionConsumer(name="cd")],
+                      pane_rows=8, window_panes=1)
+    (window,) = ws.feed(_const_lines(8, "r", "pos"))
+    strict = DriftDetector(threshold=0.1, source="features")
+    with pytest.raises(ConfigError, match="feature count table"):
+        strict.update(window)
+    lenient = DriftDetector(threshold=0.1, source="both")
+    assert lenient.update(window) is None      # class-only reference, armed
+
+
+def test_drift_detector_feature_source(ws_root):
+    """A pure covariate shift (feature marginal moves, class balance
+    unchanged) is visible to source='features' and invisible to 'class'."""
+    enc = ws_root["enc"]()
+    ws = WindowedScan(enc, [ClassDistributionConsumer(name="cd"),
+                            scan.NaiveBayesConsumer(name="nb")],
+                      pane_rows=8, window_panes=1)
+    feat = DriftDetector(threshold=0.1, min_windows=1, source="features")
+    cls = DriftDetector(threshold=0.1, min_windows=1, source="class")
+    half = _const_lines(4, "r", "pos") + _const_lines(4, "g", "neg", start=4)
+    (w0,) = ws.feed(half)
+    (w1,) = ws.feed(_const_lines(4, "b", "pos") +
+                    _const_lines(4, "b", "neg", start=4))
+    for detector in (feat, cls):
+        assert detector.update(w0) is None     # becomes reference
+    assert feat.update(w1) is not None
+    assert cls.update(w1) is None
+
+
+# ---------------------------------------------------------------------------
+# hot swap: registry versions, swap barrier, in-flight on old params
+# ---------------------------------------------------------------------------
+
+class _GateServable:
+    """Wraps a live entry: score blocks until released — freezes a batch
+    IN FLIGHT so a concurrent swap provably lands after dispatch resolved
+    the old entry."""
+
+    family = "naiveBayes"
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.compile_keys = inner.compile_keys
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def score_lines(self, lines, pad_to):
+        self.entered.set()
+        assert self.release.wait(30.0)
+        return self.inner.score_lines(lines, pad_to)
+
+    def warmup(self, pad_to):
+        self.inner.warmup(pad_to)
+
+
+@pytest.fixture(scope="module")
+def drift_ws(ws_root, tmp_path_factory):
+    """Trained steady-regime NB artifact + serving conf."""
+    root = tmp_path_factory.mktemp("driftswap")
+    train = root / "train.csv"
+    train.write_text("\n".join(gen_lines(480, seed=31)) + "\n")
+    props = {"feature.schema.file.path": ws_root["schema"],
+             "bayesian.model.file.path": str(root / "nb_model"),
+             "serve.models": "naiveBayes",
+             "serve.bucket.sizes": "1,2,4",
+             "serve.request.timeout.ms": "30000",
+             "stream.retrain.dir": str(root / "retrain")}
+    get_job("BayesianDistribution").run(
+        JobConfig(dict(props)), str(train), str(root / "nb_model"))
+    return {"props": props, "root": root}
+
+
+def test_registry_swap_versions_and_unknown(drift_ws):
+    from avenir_tpu.serving import ModelRegistry, UnknownModelError
+    from avenir_tpu.serving.registry import NaiveBayesServable
+
+    conf = JobConfig(dict(drift_ws["props"]))
+    registry = ModelRegistry.from_conf(conf)
+    assert registry.version("naiveBayes") == 1
+    old = registry.get("naiveBayes")
+    replacement = NaiveBayesServable.from_conf(conf)
+    assert registry.swap("naiveBayes", replacement) == 2
+    assert registry.get("naiveBayes") is replacement
+    assert registry.version("naiveBayes") == 2
+    # the old entry object still scores — in-flight holders are unaffected
+    line = "q1,r,s,1.5"
+    assert old.score_lines([line], 1) == replacement.score_lines([line], 1)
+    with pytest.raises(UnknownModelError):
+        registry.swap("nosuch", replacement)
+    with pytest.raises(UnknownModelError):
+        registry.version("nosuch")
+
+
+def test_drift_retrain_swap_end_to_end(ws_root, drift_ws, tmp_path):
+    """The acceptance loop: injected shift → drift.detected journal event →
+    retrain over the drifted window → registry swap → the next request is
+    served by the new model version, while a pre-swap in-flight request
+    completes on the old params."""
+    from avenir_tpu.serving import BucketedMicrobatcher, ModelRegistry
+
+    conf = JobConfig(dict(drift_ws["props"]))
+    enc = ws_root["enc"]()
+    tracer = tel.tracer().enable(str(tmp_path / "tel"))
+    try:
+        registry = ModelRegistry.from_conf(conf)
+        batcher = BucketedMicrobatcher.from_conf(registry, conf)
+        probe = "q1,r,s,1.5"                   # steady regime: r → pos
+        old_resp = batcher.submit("naiveBayes", probe)
+        assert old_resp.endswith(",pos")
+
+        # freeze one request IN FLIGHT on the steady-regime params: the
+        # gate wraps the v1 entry, and the request below resolves it at
+        # dispatch — everything the drift loop swaps in lands after
+        gate = _GateServable(registry.get("naiveBayes"))
+        registry.add("naiveBayes", gate)               # version 2
+        inflight = batcher.submit_nowait("naiveBayes", probe)
+        assert gate.entered.wait(30.0)
+
+        detector = DriftDetector(threshold=0.01, min_windows=2,
+                                 source="class")
+        controller = DriftRetrainController(conf, batcher, detector)
+        ws = WindowedScan(enc, [ClassDistributionConsumer(name="cd")],
+                          pane_rows=64, window_panes=2, retain_rows=True)
+        ws.warm()
+
+        steady = gen_lines(256, seed=37)               # windows 0, 1
+        drifted = gen_lines(512, seed=41, flip=True)   # windows 2..5
+        versions = []
+        with tracer.span("stream.soak"):
+            for window in ws.feed(steady + drifted) + ws.flush():
+                v = controller.on_window(window)
+                if v is not None:
+                    versions.append((window.index, v))
+        # windows 2 (streak 1) and 3 (streak 2 → fire): ONE retrain+swap,
+        # trained purely on flipped-regime rows
+        assert versions == [(3, 3)]
+        assert registry.version("naiveBayes") == 3
+        assert controller.swaps == 1 and controller.last_swap_s > 0
+
+        # release the gate: the pre-swap in-flight request completes on
+        # the OLD (steady-regime) params even though the registry now
+        # holds the retrained model
+        gate.release.set()
+        assert inflight.wait(30.0).endswith(",pos")
+        # the next request scores on the swapped-in drifted-regime model
+        new_resp = batcher.submit("naiveBayes", probe)
+        assert new_resp.endswith(",neg"), \
+            "post-swap requests must score on the retrained model"
+
+        # the retrain conf is a MINIMAL fit conf: the family artifact key
+        # (which would flip predict-capable jobs into scoring mode) and
+        # the live stream's durability keys never leak into the batch fit
+        controller.conf.set("stream.checkpoint.dir", "/nonexistent/ring")
+        train_conf = controller._train_conf("/tmp/artifact")
+        assert train_conf.get("bayesian.model.file.path") is None
+        assert train_conf.get("stream.checkpoint.dir") is None
+        # ...including their prefix-namespaced spellings — JobConfig reads
+        # ``avenir.<key>`` == ``<key>``, so dropping only the bare form
+        # would leak the artifact key / live checkpoint dir right back in
+        controller.conf.set("avenir.bayesian.model.file.path", "/stale")
+        controller.conf.set("avenir.stream.checkpoint.dir", "/live/ring")
+        train_conf = controller._train_conf("/tmp/artifact")
+        assert train_conf.get("bayesian.model.file.path") is None
+        assert train_conf.get("stream.checkpoint.dir") is None
+
+        # a firing on a window whose rows were lost to a resume (restored
+        # panes: lines=None, retained=True) defers instead of crashing;
+        # with retention off entirely it is a loud config error
+        from avenir_tpu.stream import DriftEvent, WindowResult
+        event = DriftEvent(window=9, divergence=0.5, streak=2,
+                           threshold=0.01)
+        restored = WindowResult(9, 0, 1, 10, None, {}, None, retained=True)
+        assert controller.retrain_and_swap(restored, event) is None
+        assert controller.counters.get("Stream", "retrain.deferred") == 1
+        unretained = WindowResult(9, 0, 1, 10, None, {}, None,
+                                  retained=False)
+        with pytest.raises(ConfigError, match="retain_rows"):
+            controller.retrain_and_swap(unretained, event)
+        batcher.close()
+    finally:
+        path = tracer.journal_path
+        tel.tracer().disable()
+    events = read_events(path)
+    kinds = [e["ev"] for e in events]
+    assert "drift.detected" in kinds
+    detected = next(e for e in events if e["ev"] == "drift.detected")
+    assert detected["window"] == 3 and detected["windows"] == 2
+    retrain = next(e for e in events if e["ev"] == "drift.retrain")
+    assert retrain["version"] == 3 and retrain["rows"] == 128
+    (swap,) = [e for e in events if e["ev"] == "model.swap"]
+    assert swap["version"] == 3 and swap["model"] == "naiveBayes"
+    assert kinds.index("drift.detected") < kinds.index("model.swap")
+    # the retrain artifact is a real job artifact (byte-compatible layout)
+    assert os.path.exists(str(drift_ws["root"] / "retrain" / "retrain-w3"
+                              / "model" / "part-00000"))

@@ -245,6 +245,19 @@ GOLDEN_EVENT_KEYS = {
     "recompile": {"ev", "ts", "trace", "span", "scope", "keys"},
     "checkpoint.save": {"ev", "ts", "trace", "span", "dir", "run", "rows",
                         "chunk"},
+    # the StreamGraft lifecycle (round 11): windowed drift scoring, the
+    # sustained-drift firing, the retrain completion, and the serving
+    # plane's hot swap — docs/observability.md event table
+    "drift.window": {"ev", "ts", "trace", "span", "window", "divergence",
+                     "threshold", "streak"},
+    "drift.detected": {"ev", "ts", "trace", "span", "window", "divergence",
+                       "threshold", "windows"},
+    "drift.retrain": {"ev", "ts", "trace", "span", "window", "model",
+                      "version", "rows", "dur_ms"},
+    "drift.retrain.failed": {"ev", "ts", "trace", "span", "window", "model",
+                             "error"},
+    "model.swap": {"ev", "ts", "trace", "span", "model", "version",
+                   "family", "warmed"},
 }
 
 
@@ -262,6 +275,16 @@ def test_golden_event_shapes(tmp_path):
         monitor.prime([(1,)])
         monitor.observe([(2,)])
         tracer.event("checkpoint.save", dir="d", run="r", rows=10, chunk=2)
+        tracer.event("drift.window", window=1, divergence=0.02,
+                     threshold=0.1, streak=0)
+        tracer.event("drift.detected", window=3, divergence=0.2,
+                     threshold=0.1, windows=2)
+        tracer.event("drift.retrain", window=3, model="naiveBayes",
+                     version=2, rows=128, dur_ms=12.5)
+        tracer.event("drift.retrain.failed", window=4, model="naiveBayes",
+                     error="OSError: no space left on device")
+        tracer.event("model.swap", model="naiveBayes", version=2,
+                     family="naiveBayes", warmed=True)
     path = tracer.journal_path
     tel.tracer().disable()
     seen = {}
