@@ -62,6 +62,9 @@ class EncodedDataset:
     class_values: List[str] = dc_field(default_factory=list)
     binned_ordinals: List[int] = dc_field(default_factory=list)
     cont_ordinals: List[int] = dc_field(default_factory=list)
+    # true (pre-ballast) row count for a padded batch; None = num_rows is
+    # already the truth.  Row accounting must read this, never count pad.
+    valid_rows: Optional[int] = None
 
     @property
     def num_rows(self) -> int:
@@ -99,6 +102,56 @@ class EncodedDataset:
             binned_ordinals=self.binned_ordinals,
             cont_ordinals=self.cont_ordinals,
         )
+
+
+def pad_rows(n_target: int, *arrays: Optional[np.ndarray], fill: int = -1):
+    """Pad axis 0 of each array up to ``n_target`` rows — THE ballast-fill
+    home (round 12): integer arrays pad with ``fill`` (default −1, which is
+    count-neutral under one-hot: a −1 code/label produces an all-zero row,
+    so pad rows drop out of EVERY count table), float arrays pad with 0
+    (moment kernels pair them with −1 labels, so they are also neutral).
+    ``parallel/mesh.pad_batch``, the stream panes and the serving batcher's
+    bucket pad all route through here so the fill contract cannot diverge
+    per call site.  None entries pass through; a single array comes back
+    bare."""
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        pad = n_target - a.shape[0]
+        if pad < 0:
+            raise ValueError(f"n_target {n_target} < batch {a.shape[0]}")
+        if pad == 0:
+            out.append(a)
+            continue
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        val = fill if np.issubdtype(a.dtype, np.integer) else 0
+        out.append(np.pad(a, widths, constant_values=val))
+    return out if len(out) > 1 else out[0]
+
+
+def pad_ballast(ds: "EncodedDataset", n_target: int,
+                fill: int = -1) -> "EncodedDataset":
+    """EncodedDataset-level ballast pad: rows [num_rows, n_target) are shape
+    ballast only.  With the default ``fill=-1`` the pad rows carry label −1
+    (ALWAYS −1, regardless of ``fill``) and code −1 — the drop-invalid
+    contract both the gram kernel and the einsum paths share, so padding
+    changes no statistic while keeping the compiled-shape set finite (mesh
+    shard staging, stream panes).  Scoring callers that mask by slicing
+    (``serving/registry._pad_ds`` — a pad row's score is computed but never
+    read) pass ``fill=0`` so their pad rows stay in-vocabulary."""
+    if ds.num_rows == n_target:
+        return ds
+    codes, cont = pad_rows(n_target, ds.codes, ds.cont, fill=fill)
+    labels = (None if ds.labels is None
+              else pad_rows(n_target, ds.labels, fill=-1))
+    return EncodedDataset(
+        codes=codes, cont=cont, labels=labels, ids=None,
+        n_bins=ds.n_bins, class_values=ds.class_values,
+        binned_ordinals=ds.binned_ordinals, cont_ordinals=ds.cont_ordinals,
+        valid_rows=(ds.valid_rows if ds.valid_rows is not None
+                    else ds.num_rows))
 
 
 def peek_chunks(data):

@@ -425,7 +425,8 @@ class Job:
 
     def encoded_data_source(self, conf: JobConfig, input_path: str,
                             counters: Counters, with_labels: bool = True,
-                            mesh=None, checkpointer=None, owner=None):
+                            mesh=None, checkpointer=None, owner=None,
+                            shard=None):
         """(encoder, data, rows_fn) for count-aggregation jobs whose model
         ``fit`` accepts either one EncodedDataset or a chunk iterable.
 
@@ -462,18 +463,25 @@ class Job:
                 owner=owner)
             depth = conf.get_int("stream.prefetch.depth", 2)
             if depth > 0:
-                from avenir_tpu.runtime.feeder import DeviceFeeder
+                from avenir_tpu.runtime.feeder import (DeviceFeeder,
+                                                       sharded_pair_stage)
 
-                def stage(item):
-                    from avenir_tpu.parallel.mesh import maybe_shard_batch
-                    ds, cur = item
-                    codes, labels, cont = maybe_shard_batch(
-                        mesh, ds.codes, ds.labels, ds.cont)
-                    return EncodedDataset(
-                        codes=codes, cont=cont, labels=labels, ids=ds.ids,
-                        n_bins=ds.n_bins, class_values=ds.class_values,
-                        binned_ordinals=ds.binned_ordinals,
-                        cont_ordinals=ds.cont_ordinals), cur
+                if shard is not None:
+                    # ShardGraft staging: ballast-pad to the pow-2 shard
+                    # target and place round-robin over the mesh data axis
+                    # on the prefetch worker (upload overlaps compute)
+                    stage = sharded_pair_stage(shard)
+                else:
+                    def stage(item):
+                        from avenir_tpu.parallel.mesh import maybe_shard_batch
+                        ds, cur = item
+                        codes, labels, cont = maybe_shard_batch(
+                            mesh, ds.codes, ds.labels, ds.cont)
+                        return EncodedDataset(
+                            codes=codes, cont=cont, labels=labels, ids=ds.ids,
+                            n_bins=ds.n_bins, class_values=ds.class_values,
+                            binned_ordinals=ds.binned_ordinals,
+                            cont_ordinals=ds.cont_ordinals), cur
 
                 pairs = DeviceFeeder(pairs, depth=depth, stage=stage)
 

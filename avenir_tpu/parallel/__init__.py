@@ -3,13 +3,17 @@ from avenir_tpu.parallel.mesh import (
     data_sharding,
     replicated,
     pad_batch,
+    shard_pad_target,
     device_put_sharded_batch,
 )
+from avenir_tpu.parallel.shard import ShardSpec
 
 __all__ = [
     "make_mesh",
     "data_sharding",
     "replicated",
     "pad_batch",
+    "shard_pad_target",
     "device_put_sharded_batch",
+    "ShardSpec",
 ]

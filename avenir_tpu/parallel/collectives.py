@@ -238,6 +238,100 @@ def sharded_mi_step(mesh: Mesh, num_classes: int, num_bins: int,
     return jax.jit(wrapped)
 
 
+def quantized_allreduce_sum(x: jax.Array, axis_name: str) -> jax.Array:
+    """EQuARX-style bandwidth-reduced all-reduce-sum (arXiv 2506.17615)
+    for count-tensor partials inside a ``shard_map``.
+
+    Each device block-quantizes its partial to int8 with one f32 scale per
+    trailing-axis row (``s = max(|row|, 127) / 127`` — never below 1, so
+    partials whose cells all fit int8 quantize EXACTLY with scale 1), then
+    ONE ``all_gather`` moves the int8 payload + scales (≈4× fewer bytes on
+    the wire than an int32/f32 ring psum) and each device dequantizes and
+    sums locally in f32.
+
+    Exact whenever every per-device partial cell is ≤ 127 in magnitude —
+    true for gram partials of chunks smaller than 127·D rows per cell —
+    and bounded by scale/2 per device otherwise, which is why this rides
+    behind ``shard.allreduce.quantized`` (default off) with the exact
+    psum as the byte-identity oracle."""
+    qmax = 127.0
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True), qmax) / qmax
+    q = jnp.round(xf / s).astype(jnp.int8)
+    qg = jax.lax.all_gather(q, axis_name)            # [D, ...] int8
+    sg = jax.lax.all_gather(s, axis_name)            # [D, ..., 1] f32
+    return jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
+
+
+@functools.lru_cache(maxsize=32)
+def sharded_scan_step(mesh: Mesh, num_bins: int, num_classes: int,
+                      data_axis: str = "data", interpret: bool = False,
+                      block_cols=None, quantized: bool = False,
+                      moments: bool = True):
+    """THE ShardGraft SharedScan dispatch (round 12): per-device Pallas
+    co-occurrence gram + class counts + class moments of ONE data-sharded
+    chunk, all-reduced over the mesh's data axis inside the compiled
+    program — the reference's combiner (per-device partials) + shuffle
+    (psum) for every table the scan's consumers collectively read, in one
+    dispatch per chunk exactly like the single-chip fast path.
+
+    Returns a jitted fn(codes [N, F] data-sharded, labels [N], cont
+    [N, Fc]) → (G, cc [C] int32, cnt [C] f32, s1 [C, Fc] f32, s2 [C, Fc]
+    f32), all replicated — or just (G, cc) under ``moments=False``
+    (count-only consumer sets).  G's layout is the single-device kernel's
+    (``pallas_hist.plan``/``w_index``), so ``counts_from_cooc`` reads it
+    out unchanged and the fold is byte-identical to the 1-chip gram;
+    per-device moment partials are exact f32 sums, so the psum'd moments
+    match the single-chip fold bit-for-bit whenever those partials are
+    exactly representable (integer-grid values — the scope the stream
+    panes already document).
+
+    ``interpret=True`` runs the kernel through the Pallas interpreter —
+    how the host-mesh tier-1 byte-identity tests attest the collective
+    wiring without Mosaic hardware.  ``quantized=True`` routes the gram
+    all-reduce (the dominant payload) through
+    :func:`quantized_allreduce_sum`; class counts and moments stay on the
+    exact psum either way.
+
+    Memoized on the full signature (``Mesh`` is hashable): every
+    ``ChunkFolder`` construction — one per ``SharedScan.run`` — reuses the
+    SAME jitted program, so a warm pass warms all later runs in the
+    process instead of each run paying a fresh trace+compile."""
+    from avenir_tpu.ops import pallas_hist
+
+    def step(codes, labels, cont):
+        _check_chunk(codes)        # per-shard f32 exact-accumulation cap
+        g = pallas_hist.cooc_counts.__wrapped__(
+            codes, labels, num_bins, num_classes, interpret=interpret,
+            block_cols=block_cols)
+        if quantized:
+            g = jnp.round(quantized_allreduce_sum(
+                g, data_axis)).astype(jnp.int32)
+        else:
+            g = jax.lax.psum(g, data_axis)
+        oh_c = _onehot(labels, num_classes)                    # [n_loc, C]
+        cnt = jnp.sum(oh_c, axis=0)                            # exact f32
+        cc = jax.lax.psum(cnt.astype(jnp.int32), data_axis)
+        if not moments:
+            # count-only consumer sets skip the moment einsums + psums
+            # entirely (the single-chip kernel path makes the same cut)
+            return g, cc
+        s1 = jnp.einsum("nc,nf->cf", oh_c, cont, precision="highest")
+        s2 = jnp.einsum("nc,nf->cf", oh_c, cont * cont,
+                        precision="highest")
+        return (g, cc,
+                jax.lax.psum(cnt, data_axis),
+                jax.lax.psum(s1, data_axis),
+                jax.lax.psum(s2, data_axis))
+
+    # norep: pallas_call outputs don't carry varying-mesh-axis metadata
+    wrapped = _shard_map_norep(
+        step, mesh,
+        (P(data_axis, None), P(data_axis), P(data_axis, None)),
+        (P(),) * (5 if moments else 2))
+    return jax.jit(wrapped)
+
+
 def sharded_cooc_step(mesh: Mesh, num_bins: int, num_classes: int,
                       interpret: bool = False, block_cols=None):
     """Data-sharded MXU co-occurrence count step (the round-3 count kernel

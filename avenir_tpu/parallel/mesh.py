@@ -69,29 +69,32 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def pad_batch(n_target: int, *arrays: np.ndarray, fill: int = -1):
     """Pad axis 0 of each array up to ``n_target`` rows.
 
-    Integer arrays pad with ``fill`` (default −1 → count-neutral under
-    one-hot); float arrays pad with 0 (moment kernels pair them with −1
-    labels, so they are also neutral).
-    """
-    out = []
-    for a in arrays:
-        if a is None:
-            out.append(None)
-            continue
-        pad = n_target - a.shape[0]
-        if pad < 0:
-            raise ValueError(f"n_target {n_target} < batch {a.shape[0]}")
-        if pad == 0:
-            out.append(a)
-            continue
-        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-        val = fill if np.issubdtype(a.dtype, np.integer) else 0
-        out.append(np.pad(a, widths, constant_values=val))
-    return out if len(out) > 1 else out[0]
+    Thin alias of :func:`avenir_tpu.core.encoding.pad_rows` — the ONE
+    ballast-fill home (integer arrays pad with ``fill``, default −1 →
+    count-neutral under one-hot; float arrays pad with 0).  Kept here so
+    mesh-side callers don't reach into ``core`` for an array utility."""
+    from avenir_tpu.core.encoding import pad_rows
+
+    return pad_rows(n_target, *arrays, fill=fill)
 
 
 def padded_size(n: int, num_shards: int) -> int:
     return ((n + num_shards - 1) // num_shards) * num_shards
+
+
+def shard_pad_target(n: int, num_shards: int) -> int:
+    """Row target for a ShardGraft-staged chunk: the next power of two ≥ n,
+    rounded up to a multiple of ``num_shards`` (every device gets an equal
+    slice, and at least one row).  For a fixed shard count the target set is
+    finite — one value per pow-2 bucket — so a steady chunk stream with a
+    ragged tail compiles a bounded shape set instead of one program per
+    tail size (the stream-pane pow-2 discipline applied to mesh staging)."""
+    if n < 1:
+        raise ValueError(f"cannot stage an empty chunk (n={n})")
+    t = 1
+    while t < n:
+        t *= 2
+    return padded_size(t, num_shards)
 
 
 def device_put_sharded_batch(mesh: Mesh, *arrays, data_axis: str = "data"):

@@ -1,0 +1,187 @@
+"""ShardGraft — the mesh-sharded SharedScan execution policy (round 12).
+
+``parallel/mesh.py`` knows how to lay arrays out over a mesh and
+``parallel/collectives.py`` knows how to psum partials across it; this
+module is the POLICY seam that turns a ``shard.*`` config family into a
+concrete sharded execution plan for the SharedScan hot loop:
+
+- ``shard.devices``           — how many local devices the 1-D data mesh
+  spans (``all`` or an integer; unset/0 = off → today's single-chip path,
+  byte-for-byte: no new dispatches, no resharding, no new keys);
+- ``shard.data.axis``         — the mesh axis name (default ``data``);
+- ``shard.allreduce.quantized`` — route the gram all-reduce through the
+  EQuARX-style int8 block-quantized collective
+  (``collectives.quantized_allreduce_sum``; default off — the exact psum
+  path remains the byte-identity oracle).
+
+The plan a :class:`ShardSpec` encodes (DrJAX-style mapreduce discipline,
+arXiv 2403.07128: placed batches in, ``psum``-reduced replicated totals
+out):
+
+1. the chunk feeder ballast-pads each chunk to its pow-2 shard target
+   (``mesh.shard_pad_target`` — label −1 rows, the drop-invalid contract,
+   so padding changes no statistic while the compiled-shape set stays
+   finite) and stages it round-robin over the ``data`` axis;
+2. ``ChunkFolder`` folds the staged chunk through ONE
+   ``collectives.sharded_scan_step`` dispatch — per-device Pallas gram +
+   class counts + class moments, all-reduced in-kernel;
+3. the 64-bit host accumulators key the gram under a MESH-QUALIFIED
+   ``g_key`` (:meth:`ShardSpec.g_suffix`), so state written under a
+   different device count / axis name fails loudly at read-out instead of
+   folding stale counts (the GL002 discipline applied to topology).
+
+Single-process only, like ``Job.auto_mesh``: multi-host runs partition
+chunks per process and merge through ``all_process_sum_state`` — the two
+composability seams are documented in docs/architecture.md (ShardGraft).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from avenir_tpu.core.config import ConfigError
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """A resolved ShardGraft plan: the mesh, its data axis, and the
+    collective flavor.  Built once per run (``from_conf``) and threaded
+    through ``SharedScan``/``ChunkFolder``/``WindowedScan`` and the chunk
+    feeder so every seam stages and folds under the SAME topology."""
+
+    mesh: object                      # jax.sharding.Mesh (1-D data mesh)
+    data_axis: str = "data"
+    quantized: bool = False
+
+    @staticmethod
+    def requested(conf) -> bool:
+        """Is a ``shard.*`` topology configured?  One predicate for every
+        caller that must agree with :meth:`from_conf`'s off-set (the
+        driver's singleton-fuse decision, span attrs) — cheap, no jax
+        import; resolution/validation stays with ``from_conf``."""
+        return conf.get("shard.devices") not in (None, "", "0")
+
+    @classmethod
+    def from_conf(cls, conf) -> Optional["ShardSpec"]:
+        """The ``shard.*`` config family → a spec, or None when unset
+        (today's single-chip path, exactly).  Refuses impossible requests
+        loudly: more devices than attached, a multi-process run (chunk
+        ownership is per-process there — ``all_process_sum_state`` is the
+        cross-host reduce), or a non-positive count."""
+        if not cls.requested(conf):
+            return None
+        raw = conf.get("shard.devices")
+        import jax
+
+        if jax.process_count() > 1:
+            raise ConfigError(
+                "shard.devices is single-process (it places globally-"
+                "addressed arrays); multi-host runs partition chunks per "
+                "process and merge via all_process_sum_state instead")
+        avail = jax.devices()
+        try:
+            n = len(avail) if str(raw).strip().lower() == "all" else int(raw)
+        except ValueError:
+            raise ConfigError(
+                f"shard.devices={raw!r} must be an integer or 'all'")
+        if n < 1:
+            raise ConfigError(f"shard.devices={raw!r} must be >= 1 or 'all'")
+        if n > len(avail):
+            raise ConfigError(
+                f"shard.devices={n} but only {len(avail)} device(s) "
+                f"attached ({avail[0].platform})")
+        axis = conf.get("shard.data.axis", "data")
+        from avenir_tpu.parallel.mesh import make_mesh
+
+        return cls(mesh=make_mesh((axis,), shape=(n,), devices=avail[:n]),
+                   data_axis=axis,
+                   quantized=conf.get_bool("shard.allreduce.quantized",
+                                           False))
+
+    # -- identity -------------------------------------------------------------
+    @property
+    def num_devices(self) -> int:
+        return int(self.mesh.shape[self.data_axis])
+
+    @property
+    def g_suffix(self) -> str:
+        """Mesh-shape qualifier appended to the gram accumulator key: a
+        resharded run (different device count or axis name) reads a
+        DIFFERENT key, and ``ChunkFolder.tables`` raises on the orphaned
+        one — stale topology state can never be silently summed."""
+        return f":mesh:{self.data_axis}{self.num_devices}"
+
+    def device_kind(self) -> str:
+        d = next(iter(np.asarray(self.mesh.devices).flat))
+        return getattr(d, "device_kind", "") or d.platform
+
+    # -- staging --------------------------------------------------------------
+    def pad_target(self, n: int) -> int:
+        from avenir_tpu.parallel.mesh import shard_pad_target
+
+        return shard_pad_target(n, self.num_devices)
+
+    def stage(self, ds):
+        """Ballast-pad an encoded chunk to its pow-2 shard target and place
+        it sharded over the data axis — the feeder-side half of the plan
+        (``runtime/feeder.sharded_pair_stage`` runs this on the prefetch
+        worker thread so the padded upload overlaps compute).  Idempotent:
+        an already-staged chunk (jax arrays carrying this mesh's batch
+        sharding) passes through untouched.  Row ids are kept as-is —
+        un-padded host metadata, exactly like the unsharded prefetch
+        stage — and ``valid_rows`` records the true pre-ballast count so
+        row accounting downstream never counts pad."""
+        import jax
+
+        from avenir_tpu.core.encoding import EncodedDataset
+
+        valid = ds.valid_rows
+        if valid is None and not isinstance(ds.codes, jax.Array):
+            valid = ds.num_rows
+        codes, labels, cont = self.shard_batch(ds.codes, ds.labels, ds.cont)
+        return EncodedDataset(
+            codes=codes, cont=cont, labels=labels, ids=ds.ids,
+            n_bins=ds.n_bins, class_values=ds.class_values,
+            binned_ordinals=ds.binned_ordinals,
+            cont_ordinals=ds.cont_ordinals, valid_rows=valid)
+
+    def shard_batch(self, codes, labels, cont):
+        """Array-level staging (the fold-side entry): ballast-pad host
+        arrays to the shard target, then place over the data axis; arrays
+        already carrying this mesh's batch sharding pass through."""
+        import jax
+
+        from avenir_tpu.parallel.mesh import maybe_shard_batch, pad_batch
+
+        if not isinstance(codes, jax.Array):
+            n = codes.shape[0]
+            codes, labels, cont = pad_batch(self.pad_target(n), codes,
+                                            labels, cont)
+        return maybe_shard_batch(self.mesh, codes, labels, cont,
+                                 data_axis=self.data_axis)
+
+    # -- telemetry ------------------------------------------------------------
+    def announce(self, tracer=None) -> dict:
+        """Journal the run's hardware identity (``shard.topology``: device
+        kind, mesh shape, axis names) so any bench/journal artifact is
+        self-describing about what it ran on; returns the payload for
+        callers embedding it in their own artifacts."""
+        topo = {
+            "devices": self.num_devices,
+            "device_kind": self.device_kind(),
+            "mesh": {k: int(v) for k, v in self.mesh.shape.items()},
+            "axes": list(self.mesh.axis_names),
+        }
+        if tracer is None:
+            from avenir_tpu.telemetry import spans as tel
+
+            tracer = tel.tracer()
+        # once per journal per topology: several seams announce (the
+        # driver's fused scan, the streaming job) and a run's journal must
+        # carry ONE hardware identity — a run mixing topologies (distinct
+        # shard.* stage props) still journals each distinct one
+        tracer.event_once("shard.topology", self.g_suffix, **topo)
+        return topo

@@ -100,9 +100,10 @@ class Pipeline:
         SAME input artifact, none consuming another group member's output,
         none already satisfied under ``resume``, and all stage confs
         compatible (same schema/delimiter/stream keys — see
-        ``pipeline/scan.py``).  Returns ``(stages, confs)`` — a singleton
-        when nothing fuses; the confs are reused by the caller so a stage
-        conf is only ever built once."""
+        ``pipeline/scan.py``).  Returns ``(stages, confs, fuse)`` — fuse
+        True when the group (even a singleton, under a shard.* topology)
+        should run through the one SharedScan; the confs are reused by the
+        caller so a stage conf is only ever built once."""
         from avenir_tpu.pipeline import scan
 
         first = todo[i]
@@ -123,9 +124,16 @@ class Pipeline:
             group.append(s)
             confs.append(conf)
             outputs.add(s.output)
-        if len(group) > 1 and scan.stages_compatible(confs):
-            return group, confs
-        return [first], confs[:1]
+        # a SINGLETON count stage still routes through the one SharedScan
+        # when a shard.* topology is configured: the mesh-sharded fold
+        # lives only there, and a shard.devices request silently running
+        # the single-chip standalone path would contradict the journal
+        from avenir_tpu.parallel.shard import ShardSpec
+
+        if group and scan.stages_compatible(confs) and (
+                len(group) > 1 or ShardSpec.requested(confs[0])):
+            return group, confs, True
+        return [first], confs[:1], False
 
     def rollup(self) -> Counters:
         """Run-level counter rollup: the SUM of every stage's counters
@@ -161,6 +169,16 @@ class Pipeline:
                          attrs={"workspace": self.workspace,
                                 "stages": len(todo),
                                 "resume": bool(resume)}):
+            # ShardGraft (round 12): resolve the shard.* topology once at
+            # run start so an impossible request (more devices than
+            # attached, multi-process) fails HERE, before any stage runs.
+            # The journal's shard.topology event is emitted by the seams
+            # that actually fold sharded (run_fused_stages, the streaming
+            # job) — announce() dedupes per journal — so the artifact
+            # never claims parallelism that did not execute
+            from avenir_tpu.parallel.shard import ShardSpec
+
+            ShardSpec.from_conf(self.conf)
             self._run_stages(todo, resume, tracer)
             tracer.counters("pipeline", self.rollup())
         return self.counters
@@ -186,8 +204,8 @@ class Pipeline:
             # same artifact with a compatible schema collapse into ONE
             # SharedScan — one parse+encode+gram pass serving every stage
             # (scan.fuse=false opts a stage or the whole pipeline out)
-            group, gconfs = self._scan_group(todo, i, resume)
-            if len(group) > 1:
+            group, gconfs, fuse = self._scan_group(todo, i, resume)
+            if fuse:
                 from avenir_tpu.pipeline import scan
 
                 with tracer.span("scan.fused",
@@ -207,11 +225,17 @@ class Pipeline:
                 i += len(group)
                 continue
             conf = gconfs[0] if gconfs else self._stage_conf(stage)
-            with tracer.span(f"stage.{stage.name}",
-                             attrs={"job": (stage.job if isinstance(
-                                 stage.job, str) else getattr(
-                                     stage.job, "__name__", "callable")),
-                                    "output": out}):
+            attrs = {"job": (stage.job if isinstance(stage.job, str)
+                             else getattr(stage.job, "__name__", "callable")),
+                     "output": out}
+            from avenir_tpu.parallel.shard import ShardSpec
+
+            if ShardSpec.requested(conf):
+                # shard.* covers only the SharedScan fold (fused count
+                # stages, streaming); this stage runs its normal path —
+                # say so in the trace instead of implying parallelism
+                attrs["sharded"] = stage.job == "StreamAnalytics"
+            with tracer.span(f"stage.{stage.name}", attrs=attrs):
                 self.counters[stage.name] = stage.run(
                     conf, self.path(stage.input), out)
                 tracer.counters(stage.name, self.counters[stage.name])

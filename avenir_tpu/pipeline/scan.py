@@ -273,15 +273,18 @@ class ChunkFolder:
     """
 
     def __init__(self, consumers: Sequence[ScanConsumer],
-                 meta: EncodedDataset, mesh=None, pair_chunk: int = 256):
+                 meta: EncodedDataset, mesh=None, pair_chunk: int = 256,
+                 shard=None, counters: Optional[Counters] = None):
         from avenir_tpu.ops import pallas_hist
 
         if not consumers:
             raise ScanError("no consumers registered")
         self.consumers = list(consumers)
         self.meta = meta
-        self.mesh = mesh
+        self.shard = shard                # parallel/shard.ShardSpec or None
+        self.mesh = shard.mesh if shard is not None else mesh
         self.pair_chunk = pair_chunk
+        self.counters = counters          # optional Shard telemetry home
         f, b, c = meta.num_binned, meta.max_bins, meta.num_classes
         self.f, self.b, self.c = f, b, c
         self.needs_counts = any(x.needs_bin or x.needs_pairs
@@ -296,10 +299,23 @@ class ChunkFolder:
         self.pair_index = (np.array(union, np.int32).reshape(-1, 2) if union
                            else np.zeros((0, 2), np.int32))
         # count-path routing: single source of truth with the standalone
-        # fast paths (MutualInformation.fit / bench.py / e2e_pipeline)
-        self.step = self._sharded = None
+        # fast paths (MutualInformation.fit / bench.py / e2e_pipeline).
+        # An explicit ShardSpec (round 12) takes the fused shard_map+psum
+        # dispatch whenever the kernel shape gates pass — interpret-mode
+        # off TPU, so the host-mesh tests attest the same program — and
+        # falls back to the sharded-einsum path (XLA auto-collectives over
+        # the placed batch) for shapes the gram kernel cannot take.
+        self.step = self._sharded = self._shard_step = None
         if self.needs_counts:
-            if pallas_hist.use_kernel(f, b, c, mesh=self.mesh):
+            if shard is not None and pallas_hist.applicable(f, b, c):
+                from avenir_tpu.parallel import collectives
+                self._shard_step = collectives.sharded_scan_step(
+                    shard.mesh, b, c, data_axis=shard.data_axis,
+                    interpret=not pallas_hist.mesh_on_tpu(shard.mesh),
+                    quantized=shard.quantized,
+                    moments=self.needs_moments)
+                self.step = "shard"
+            elif pallas_hist.use_kernel(f, b, c, mesh=self.mesh):
                 self.step = "kernel"
             elif (pallas_hist.applicable(f, b, c)
                     and pallas_hist.mesh_on_tpu(self.mesh)):
@@ -308,15 +324,56 @@ class ChunkFolder:
                 self.step = "sharded"
             else:
                 self.step = "einsum"
-        self.gk = pallas_hist.g_key(f, b, c)
+        # mesh-qualified on the shard path: state folded under one topology
+        # must never be silently summed under another (tables() raises on
+        # an orphaned g: key — the GL002 discipline applied to mesh shape)
+        self.gk = pallas_hist.g_key(f, b, c) + (
+            shard.g_suffix if self.step == "shard" else "")
+        # logical all-reduce payload per fused shard dispatch (telemetry):
+        # the gram (int8+scales when quantized, int32 psum otherwise) plus
+        # the class-count/moment psums
+        if self.step == "shard":
+            mode, _, wp = pallas_hist.plan(f, b, c)
+            cells = (c * wp * wp) if mode in ("cls", "clsb") else (wp * wp)
+            rows = cells // wp
+            gbytes = (cells + 4 * rows if shard.quantized else 4 * cells)
+            self._collective_bytes = gbytes + 4 * c * (
+                2 + 2 * meta.num_cont if self.needs_moments else 1)
 
     def fold(self, ds: EncodedDataset, acc: agg.Accumulator) -> None:
         """One chunk's device pass + 64-bit host accumulation into ``acc``."""
         from avenir_tpu.ops import pallas_hist
         from avenir_tpu.parallel.mesh import maybe_shard_batch
 
-        codes, labels, cont = maybe_shard_batch(
-            self.mesh, ds.codes, ds.labels, ds.cont)
+        if self.shard is not None:
+            codes, labels, cont = self.shard.shard_batch(
+                ds.codes, ds.labels, ds.cont)
+        else:
+            codes, labels, cont = maybe_shard_batch(
+                self.mesh, ds.codes, ds.labels, ds.cont)
+        if self.step == "shard":
+            # ONE fused dispatch: per-device gram + class counts (+ class
+            # moments when any consumer reads them), psum'd in-kernel over
+            # the data axis — class counts ride the collective instead of
+            # a second dispatch
+            if self.needs_moments:
+                g, cc, cnt, s1, s2 = self._shard_step(codes, labels, cont)
+            else:
+                g, cc = self._shard_step(codes, labels, cont)
+            acc.add("class", cc)
+            acc.add(self.gk, g)
+            if self.needs_moments:
+                acc.add("cont_count", cnt)
+                acc.add("cont_sum", s1)
+                acc.add("cont_sumsq", s2)
+            if self.counters is not None:
+                # staged rows include ballast; true row counts live with
+                # the stream cursor (Records::Processed), so the Shard
+                # group reports only what this seam measures exactly
+                self.counters.increment("Shard", "chunks")
+                self.counters.increment("Shard", "collective.bytes",
+                                        self._collective_bytes)
+            return
         acc.add("class", agg.class_counts(labels, self.c))
         moments_done = False
         if self.step == "kernel":
@@ -363,6 +420,21 @@ class ChunkFolder:
         from avenir_tpu.ops import pallas_hist
 
         f, b, c = self.f, self.b, self.c
+        if self.needs_counts:
+            # refuse FOREIGN gram keys even when our own is also present:
+            # a mixed accumulator (panes restored under one topology, new
+            # folds under another) would silently drop the foreign counts
+            # from fbc/pcc while class totals still include their rows
+            foreign = [k for k in acc.names()
+                       if k.startswith("g:") and k != self.gk]
+            if foreign:
+                raise ScanError(
+                    f"accumulator holds gram state under {foreign} but "
+                    f"this fold reads {self.gk!r} — the kernel layout or "
+                    f"mesh topology (shard.devices / shard.data.axis) "
+                    f"changed since that state was written; a resharded "
+                    f"run must start from a clean accumulator, not fold "
+                    f"stale counts")
         fbc = pcc = None
         if self.needs_counts and self.gk in acc:
             fbc, pcc = pallas_hist.counts_from_cooc(
@@ -412,9 +484,12 @@ class SharedScan:
     streaming consumers (``stream/windows.py``) fold the exact same code.
     """
 
-    def __init__(self, mesh=None, pair_chunk: int = 256):
+    def __init__(self, mesh=None, pair_chunk: int = 256, shard=None,
+                 counters: Optional[Counters] = None):
         self.mesh = mesh
         self.pair_chunk = pair_chunk
+        self.shard = shard                # parallel/shard.ShardSpec or None
+        self.counters = counters
         self.chunks_seen = 0              # set by run(); fused stages report it
         self._consumers: List[ScanConsumer] = []
 
@@ -438,27 +513,35 @@ class SharedScan:
                 "SharedScan requires labels: every shared table is "
                 "class-conditioned (see the row-validity contract)")
         folder = ChunkFolder(self._consumers, meta, mesh=self.mesh,
-                             pair_chunk=self.pair_chunk)
+                             pair_chunk=self.pair_chunk, shard=self.shard,
+                             counters=self.counters)
         from avenir_tpu.telemetry import spans as tel
 
         tracer = tel.tracer()
         acc = agg.Accumulator()
         rows = 0
         self.chunks_seen = 0
-        with tracer.span("scan", attrs={
-                "consumers": [x.name for x in self._consumers],
-                "path": folder.step or "moments"}) as scan_span:
+        attrs = {"consumers": [x.name for x in self._consumers],
+                 "path": folder.step or "moments"}
+        if self.shard is not None:
+            attrs["shard.devices"] = self.shard.num_devices
+            attrs["shard.axis"] = self.shard.data_axis
+        with tracer.span("scan", attrs=attrs) as scan_span:
             for ds in chunks:
+                # a pre-staged chunk (sharded prefetch) arrives ballast-
+                # padded; valid_rows is its true count — never count pad
+                true_rows = (ds.valid_rows if ds.valid_rows is not None
+                             else ds.num_rows)
                 with tracer.span("scan.chunk",
                                  attrs={"chunk": self.chunks_seen,
-                                        "rows": ds.num_rows}):
+                                        "rows": true_rows}):
                     # host accumulation inside fetches every device result,
                     # so the chunk span's close is naturally synced.
                     # Recompile accounting lives with the chunk SOURCE
                     # (jobs' _chunk_telemetry) — a second monitor here
                     # would double-count the same stream
                     folder.fold(ds, acc)
-                rows += ds.num_rows
+                rows += true_rows
                 self.chunks_seen += 1
             scan_span.set("chunks", self.chunks_seen)
             scan_span.set("rows", rows)
@@ -474,10 +557,12 @@ FUSABLE_JOBS = ("BayesianDistribution", "MutualInformation",
 
 # conf keys that must agree across fused stages: they shape the shared
 # encode (schema, delimiters) and the shared stream (chunking, prefetch,
-# device-mesh policy)
+# device-mesh policy — incl. the ShardGraft topology, which decides the
+# staging pad targets and the fused dispatch the one scan compiles)
 _COMPAT_KEYS = ("feature.schema.file.path", "field.delim.regex",
                 "field.delim", "stream.chunk.rows", "stream.prefetch.depth",
-                "data.parallel.auto")
+                "data.parallel.auto", "shard.devices", "shard.data.axis",
+                "shard.allreduce.quantized")
 
 
 def stage_fusable(job, conf) -> bool:
@@ -539,14 +624,23 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
     in_path = stages[0][2]
     job_obj = Job()
     schema = Job.load_schema(first_conf)
-    mesh = Job.auto_mesh(first_conf)
+    # ShardGraft (round 12): an explicit shard.* topology supersedes the
+    # implicit auto-mesh — one spec decides the staging pad targets, the
+    # fused shard_map dispatch, and the mesh-qualified accumulator keys
+    from avenir_tpu.parallel.shard import ShardSpec
+
+    spec = ShardSpec.from_conf(first_conf)
+    mesh = spec.mesh if spec is not None else Job.auto_mesh(first_conf)
     counters = {name: Counters() for name, *_ in stages}
     # the first stage's Counters carries the stream-side telemetry
-    # (Telemetry::recompiles via _chunk_telemetry) — one scan, one
-    # accounting home
+    # (Telemetry::recompiles via _chunk_telemetry, the Shard counter
+    # group) — one scan, one accounting home
+    if spec is not None:
+        spec.announce()       # deduped per journal — one event per run
     enc, data, rows_fn = job_obj.encoded_data_source(
-        first_conf, in_path, counters[stages[0][0]], mesh=mesh)
-    engine = SharedScan(mesh=mesh)
+        first_conf, in_path, counters[stages[0][0]], mesh=mesh, shard=spec)
+    engine = SharedScan(mesh=mesh, shard=spec,
+                        counters=counters[stages[0][0]])
     writers = {}
     for name, job, _inp, out_path, conf in stages:
         if job == "BayesianDistribution":

@@ -201,6 +201,22 @@ class DeviceFeeder:
         return item
 
 
+def sharded_pair_stage(shard):
+    """DeviceFeeder stage for ShardGraft chunk streams: ballast-pad each
+    encoded chunk to its pow-2 shard target (label −1 rows — the
+    drop-invalid contract, so the pad changes no statistic while the
+    compiled-shape set stays finite) and ``device_put`` it sharded over the
+    mesh's data axis — chunks land round-robin across the chips as the
+    worker thread pulls them, so the padded upload overlaps the compiled
+    fold exactly like the single-device prefetch path.  Items are the
+    ``(EncodedDataset, cursor)`` pairs ``iter_encoded_retrying`` emits."""
+    def stage(item):
+        ds, cur = item
+        return shard.stage(ds), cur
+
+    return stage
+
+
 def prefetch_encoded(path: str, encoder, ncols: int, delim: str = ",",
                      chunk_bytes: int = 64 << 20, with_labels: bool = True,
                      depth: int = 2,

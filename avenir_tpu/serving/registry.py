@@ -30,7 +30,8 @@ import numpy as np
 
 from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.core.csv_io import read_csv_string
-from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.core.encoding import (DatasetEncoder, EncodedDataset,
+                                      pad_ballast)
 from avenir_tpu.jobs.base import Job, read_lines
 from avenir_tpu.serving.errors import RequestError
 
@@ -42,18 +43,14 @@ from avenir_tpu.serving.errors import RequestError
 def _pad_ds(ds: EncodedDataset, pad_to: int) -> EncodedDataset:
     """Pad the batch axis with neutral zero rows up to the bucket size; the
     caller slices outputs back to the real row count, so pad rows are pure
-    shape ballast (mask-by-slicing — a pad row's score is never read)."""
-    pad = pad_to - ds.num_rows
-    if pad < 0:
+    shape ballast (mask-by-slicing — a pad row's score is never read).
+    Routes through the shared :func:`~avenir_tpu.core.encoding.pad_ballast`
+    contract with ``fill=0``: scoring pad rows stay in-vocabulary (an
+    all-zero request row scores without error), unlike count-path ballast
+    whose −1 labels must drop out of every table."""
+    if pad_to < ds.num_rows:
         raise ValueError(f"batch of {ds.num_rows} rows exceeds bucket {pad_to}")
-    if pad == 0:
-        return ds
-    return EncodedDataset(
-        codes=np.pad(ds.codes, ((0, pad), (0, 0))),
-        cont=np.pad(ds.cont, ((0, pad), (0, 0))),
-        labels=None, ids=None, n_bins=ds.n_bins,
-        class_values=ds.class_values,
-        binned_ordinals=ds.binned_ordinals, cont_ordinals=ds.cont_ordinals)
+    return pad_ballast(ds, pad_to, fill=0)
 
 
 def _blank_ds(enc: DatasetEncoder, n: int) -> EncodedDataset:

@@ -67,6 +67,11 @@ class StreamAnalytics(Job):
         enc = self.encoder_for(conf)
         pane_rows = conf.get_int("stream.pane.rows", 1024)
         window_panes = conf.get_int("stream.window.panes", 1)
+        from avenir_tpu.parallel.shard import ShardSpec
+
+        shard = ShardSpec.from_conf(conf)
+        if shard is not None:
+            shard.announce()     # journal the hardware identity (round 12)
         detector = DriftDetector.from_conf(conf, counters)
         ckpt = WindowCheckpointer.from_conf(conf)
         if ckpt is not None and detector is not None:
@@ -87,7 +92,8 @@ class StreamAnalytics(Job):
             window_panes=window_panes,
             slide_panes=conf.get_int("stream.slide.panes", window_panes),
             delim=conf.field_delim_regex,
-            mesh=self.auto_mesh(conf),
+            mesh=None if shard is not None else self.auto_mesh(conf),
+            shard=shard,
             pad_pow2=conf.get_bool("stream.pane.pad.pow2", True),
             retain_rows=conf.get_bool("stream.retain.rows", False),
             counters=counters, checkpointer=ckpt,

@@ -53,7 +53,8 @@ import numpy as np
 
 from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.core.csv_io import read_csv_string
-from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.core.encoding import (DatasetEncoder, EncodedDataset,
+                                      pad_ballast)
 from avenir_tpu.ops import agg
 from avenir_tpu.pipeline import scan
 from avenir_tpu.telemetry import spans as tel
@@ -149,7 +150,7 @@ class WindowedScan:
                  mesh=None, pad_pow2: bool = True, retain_rows: bool = False,
                  counters: Optional[Counters] = None,
                  checkpointer: Optional["WindowCheckpointer"] = None,
-                 crash_after_panes: int = 0, on_window=None):
+                 crash_after_panes: int = 0, on_window=None, shard=None):
         if not encoder.schema_complete(with_labels=True) or \
                 not encoder.class_values:
             raise ConfigError(
@@ -182,7 +183,13 @@ class WindowedScan:
         # snapshot and a resume replays neither side twice
         self.on_window = on_window
         self.meta = _meta_ds(encoder)
-        self.folder = scan.ChunkFolder(consumers, self.meta, mesh=mesh)
+        # a ShardSpec gives the pane fold the SAME mesh-sharded dispatch
+        # batch SharedScan runs (windows inherit sharding through
+        # ChunkFolder — no stream-side parallel code at all); the fold
+        # ballast-pads each pow-2 pane on to its shard target, so the
+        # compiled-shape set stays finite and warm() covers it
+        self.folder = scan.ChunkFolder(consumers, self.meta, mesh=mesh,
+                                       shard=shard, counters=self.counters)
         self.buckets = _pow2_buckets(self.pane_rows)
         self._monitor = tel.CompileKeyMonitor(self.counters, group="Stream",
                                               scope="stream.pane")
@@ -281,23 +288,15 @@ class WindowedScan:
         return self.enc.transform(rows, with_labels=True)
 
     def _pad(self, ds: EncodedDataset) -> EncodedDataset:
-        """Pad the pane to its power-of-two row bucket with label −1 rows:
-        out-of-range labels drop out of EVERY count table (both gram and
-        einsum paths share the drop-invalid contract), so the pad is pure
-        shape ballast and the compiled-shape set stays finite."""
+        """Pad the pane to its power-of-two row bucket with ballast rows
+        (label −1 — ``core.encoding.pad_ballast``, the one shared fill
+        contract): out-of-range labels drop out of EVERY count table (both
+        gram and einsum paths share the drop-invalid contract), so the pad
+        is pure shape ballast and the compiled-shape set stays finite."""
         if not self.pad_pow2:
             return ds
-        target = next(b for b in self.buckets if b >= ds.num_rows)
-        pad = target - ds.num_rows
-        if pad == 0:
-            return ds
-        return EncodedDataset(
-            codes=np.pad(ds.codes, ((0, pad), (0, 0))),
-            cont=np.pad(ds.cont, ((0, pad), (0, 0))),
-            labels=np.pad(ds.labels, (0, pad), constant_values=-1),
-            ids=None, n_bins=ds.n_bins, class_values=ds.class_values,
-            binned_ordinals=ds.binned_ordinals,
-            cont_ordinals=ds.cont_ordinals)
+        return pad_ballast(ds,
+                           next(b for b in self.buckets if b >= ds.num_rows))
 
     # -- window emission ------------------------------------------------------
     def _emit_windows(self) -> List[WindowResult]:

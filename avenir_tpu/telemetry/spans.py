@@ -142,6 +142,7 @@ class Tracer:
         self.journal: Optional[Journal] = None
         self._seq = itertools.count(1)           # thread-safe in CPython
         self._lock = threading.Lock()
+        self._once: set = set()                  # event_once keys, per journal
 
     # -- lifecycle -----------------------------------------------------------
     def enable(self, journal_dir: Optional[str] = None,
@@ -155,6 +156,7 @@ class Tracer:
                 path = os.path.join(journal_dir,
                                     f"run-{_new_id('')}.jsonl")
                 self.journal = Journal(path, max_bytes=max_bytes)
+            self._once.clear()                   # fresh journal, fresh onces
             self.enabled = True
         return self
 
@@ -162,6 +164,7 @@ class Tracer:
         """Turn tracing off and close the journal (tests, run teardown)."""
         with self._lock:
             self.enabled = False
+            self._once.clear()
             if self.journal is not None:
                 self.journal.close()
                 self.journal = None
@@ -249,6 +252,19 @@ class Tracer:
             fields.setdefault("trace", cur.trace_id)
             fields.setdefault("span", cur.span_id)
         self._journal_emit(ev, **fields)
+
+    def event_once(self, ev: str, key, **fields) -> None:
+        """Journal an event at most once per journal per ``(ev, key)`` —
+        for run-identity facts (e.g. ``shard.topology``) that several
+        seams may announce; later duplicates are dropped, and a run
+        carrying genuinely distinct facts (different keys) journals each."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if (ev, key) in self._once:
+                return
+            self._once.add((ev, key))
+        self.event(ev, **fields)
 
     def counters(self, scope: str, counters) -> None:
         """Journal a named counter snapshot (the CLI renders per-scope

@@ -1,0 +1,136 @@
+"""Subprocess worker for the ShardGraft byte-identity gate (round 12).
+
+Launched by tests/test_shard.py with ``JAX_PLATFORMS=cpu`` and
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` set EXPLICITLY in
+the child environment — the 8-device host mesh is forced here, not
+inherited from however pytest was invoked, so the sharded == single-chip
+assertion holds in any environment with zero TPUs attached.
+
+Asserts, per consumer (NB / MI / correlation / Fisher / moments):
+sharded SharedScan fold == single-chip fold, byte-for-byte, over a
+multi-chunk stream with a ragged tail — and the same for the streaming
+window path (WindowedScan with a ShardSpec vs the unsharded scan),
+including a ragged tail pane.  Prints ``shard worker ok`` and exits 0 on
+success; any mismatch raises and the parent surfaces the output.
+"""
+
+import os
+import sys
+
+# the mesh must exist before jax initializes — this is the whole point of
+# running in a subprocess (the parent cannot re-shape an initialized jax)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == 8, jax.devices()
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.pipeline import scan
+    from avenir_tpu.stream.windows import WindowedScan
+
+    n, f, b, c, fc = 1500, 4, 5, 2, 2
+    rng = np.random.default_rng(3)
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    # 1/16-grid values: pane/shard-partial f32 sums are exact, so the
+    # moment tables are byte-identical under ANY summation order
+    cont = (rng.integers(0, 16, size=(n, fc)) / 16.0).astype(np.float32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    ds = EncodedDataset(
+        codes=codes, cont=cont, labels=labels,
+        n_bins=np.full(f, b, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(f)),
+        cont_ordinals=list(range(f, f + fc)))
+
+    def chunks():
+        # 700/700/100: the tail exercises the ragged pow-2 staging path
+        return iter([ds.slice(i, min(i + 700, n)) for i in range(0, n, 700)])
+
+    def engine(shard=None):
+        eng = scan.SharedScan(shard=shard)
+        eng.register(scan.NaiveBayesConsumer(name="nb"))
+        eng.register(scan.MutualInfoConsumer(name="mi"))
+        eng.register(scan.CorrelationConsumer(name="cramer",
+                                              against_class=True))
+        eng.register(scan.FisherConsumer(name="fisher"))
+        eng.register(scan.MomentsConsumer(name="moments"))
+        return eng
+
+    spec = ShardSpec.from_conf(JobConfig({"shard.devices": "8"}))
+    assert spec.num_devices == 8
+    base = engine().run(chunks())
+    out = engine(spec).run(chunks())
+
+    eq = np.testing.assert_array_equal
+    eq(out["nb"].bin_counts, base["nb"].bin_counts)
+    eq(out["nb"].class_counts, base["nb"].class_counts)
+    eq(out["nb"].cont_count, base["nb"].cont_count)
+    eq(out["nb"].cont_sum, base["nb"].cont_sum)
+    eq(out["nb"].cont_sumsq, base["nb"].cont_sumsq)
+    eq(out["mi"].feature_class_counts, base["mi"].feature_class_counts)
+    eq(out["mi"].pair_class_counts, base["mi"].pair_class_counts)
+    assert out["mi"].to_lines() == base["mi"].to_lines()
+    eq(out["cramer"].contingency, base["cramer"].contingency)
+    assert out["cramer"].to_lines() == base["cramer"].to_lines()
+    eq(out["fisher"].mean, base["fisher"].mean)
+    eq(out["fisher"].var, base["fisher"].var)
+    for got, want in zip(out["moments"], base["moments"]):
+        eq(got, want)
+
+    # streaming window path: sharded panes == unsharded panes, ragged tail
+    # pane included (1500 % 256 != 0)
+    lines = [",".join([f"r{i}"] + [str(int(v)) for v in codes[i]]
+                      + [repr(float(x)) for x in cont[i]]
+                      + [["a", "b"][int(labels[i])]])
+             for i in range(n)]
+
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.core.encoding import DatasetEncoder
+
+    fields = [{"name": "id", "ordinal": 0, "id": True, "dataType": "string"}]
+    for j in range(f):
+        fields.append({"name": f"f{j}", "ordinal": 1 + j, "feature": True,
+                       "dataType": "categorical",
+                       "cardinality": [str(v) for v in range(b)]})
+    for j in range(fc):
+        fields.append({"name": f"x{j}", "ordinal": 1 + f + j,
+                       "feature": True, "dataType": "double"})
+    fields.append({"name": "cls", "ordinal": 1 + f + fc,
+                   "dataType": "categorical", "cardinality": ["a", "b"]})
+    enc = DatasetEncoder(FeatureSchema.from_json({"fields": fields}))
+
+    def windows(shard=None):
+        ws = WindowedScan(
+            enc, [scan.NaiveBayesConsumer(name="nb"),
+                  scan.MutualInfoConsumer(name="mi")],
+            pane_rows=256, window_panes=2, slide_panes=1, shard=shard)
+        ws.warm()
+        got = ws.feed(lines)
+        got.extend(ws.flush())
+        return got
+
+    plain, sharded = windows(), windows(spec)
+    assert len(plain) == len(sharded) and plain, len(plain)
+    for wp, wsh in zip(plain, sharded):
+        eq(wsh.results["nb"].bin_counts, wp.results["nb"].bin_counts)
+        eq(wsh.results["nb"].cont_sumsq, wp.results["nb"].cont_sumsq)
+        eq(wsh.results["mi"].pair_class_counts,
+           wp.results["mi"].pair_class_counts)
+        assert wsh.results["mi"].to_lines() == wp.results["mi"].to_lines()
+
+    print("shard worker ok")
+
+
+if __name__ == "__main__":
+    main()

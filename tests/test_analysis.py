@@ -361,11 +361,16 @@ def test_whole_tree_zero_nonbaselined_findings():
     # tests/test_stream.py likewise (round 11) — stream tests drive the
     # windowed fold + checkpoint + drift→swap loops, where an undocumented
     # stream.* key (GL004) or unfingerprinted snapshot (GL002) would hide
+    # tests/test_shard.py + shard_worker.py likewise (round 12) — the
+    # ShardGraft byte-identity gate drives the sharded fold loop, where an
+    # undocumented shard.* key (GL004) or a sync-in-loop (GL005) would hide
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
          str(REPO / "tests" / "test_telemetry.py"),
-         str(REPO / "tests" / "test_stream.py")],
+         str(REPO / "tests" / "test_stream.py"),
+         str(REPO / "tests" / "test_shard.py"),
+         str(REPO / "tests" / "shard_worker.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

@@ -258,6 +258,11 @@ GOLDEN_EVENT_KEYS = {
                              "error"},
     "model.swap": {"ev", "ts", "trace", "span", "model", "version",
                    "family", "warmed"},
+    # ShardGraft (round 12): the run's hardware identity — journaled at
+    # run start so every bench/journal artifact self-describes what it
+    # ran on (device kind, mesh shape, axis names)
+    "shard.topology": {"ev", "ts", "trace", "span", "devices",
+                       "device_kind", "mesh", "axes"},
 }
 
 
@@ -285,6 +290,8 @@ def test_golden_event_shapes(tmp_path):
                      error="OSError: no space left on device")
         tracer.event("model.swap", model="naiveBayes", version=2,
                      family="naiveBayes", warmed=True)
+        tracer.event("shard.topology", devices=8, device_kind="cpu",
+                     mesh={"data": 8}, axes=["data"])
     path = tracer.journal_path
     tel.tracer().disable()
     seen = {}
