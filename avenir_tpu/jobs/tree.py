@@ -47,9 +47,14 @@ def _tree_params(conf: JobConfig) -> dict:
         # split.selection.path device|host: where per-level split scoring/
         # selection runs (byte-identical trees either way — see
         # models/tree.py); split.search exhaustive|binary picks the
-        # candidate family (binary = sorted-threshold sklearn-comparable)
+        # candidate family (binary = sorted-threshold sklearn-comparable);
+        # tree.hist.mode direct|cumsum|subtract picks the level-table /
+        # split-histogram strategy (cumsum = one bin-axis prefix sum
+        # serves every binary threshold; subtract = sibling-subtraction
+        # level tables — both byte-identical to direct)
         selection=conf.get("split.selection.path", "device"),
         split_search=conf.get("split.search", "exhaustive"),
+        hist_mode=conf.get("tree.hist.mode", "direct"),
     )
 
 
@@ -125,12 +130,17 @@ class ClassPartitionGenerator(Job):
             # one dispatch against the resident table; the fetch is the
             # [S, 1] score sheet (plus the small [S, G, 1, C] histograms
             # only when the distribution columns are requested), never
-            # the table
+            # the table.  tree.hist.mode cumsum/subtract + an all-binary
+            # candidate family routes the histograms through the
+            # cumulative-table gather (bit-identical scores)
+            binary = p["hist_mode"] != "direct" and flat.all_binary
             scores, hist = jax.device_get(dtree._device_score_all(
                 table_dev, flat.seg_tab_dev, flat.attr_dev, flat.nseg_dev,
-                jnp.float32(parent_info or 0.0), algorithm=p["algorithm"],
+                jnp.float32(parent_info or 0.0),
+                flat.thr_dev if binary else None, algorithm=p["algorithm"],
                 gmax=flat.gmax, chunk=flat.chunk,
-                has_parent=parent_info is not None, want_hist=out_distr))
+                has_parent=parent_info is not None, want_hist=out_distr,
+                binary=binary))
             lines = [emit_row(sp, scores[si, 0],
                               hist[si, :, 0, :] if out_distr else None)
                      for si, sp in enumerate(flat.splits)]
@@ -238,8 +248,21 @@ class DecisionTreeBuilder(Job):
             seed=conf.get_int("seed", 0),
             mesh=self.auto_mesh(conf),
             selection=p["selection"], split_search=p["split_search"],
+            hist_mode=p["hist_mode"],
+            collect_phase_stats=conf.get_bool("tree.hist.phase.stats", False),
         )
         model = trainer.fit(ds, is_cat)
+        # opt-in per-level phase breakdown (table-build / score / partition
+        # µs as TreePhase counters — the attribution artifact behind the
+        # benchmarks' hist-mode comparison)
+        for st in trainer.level_stats:
+            lv = st["level"]
+            counters.set("TreePhase", f"level.{lv}.table.us",
+                         int(st["table_ms"] * 1e3))
+            counters.set("TreePhase", f"level.{lv}.select.us",
+                         int(st["select_ms"] * 1e3))
+            counters.set("TreePhase", f"level.{lv}.partition.us",
+                         int(st["partition_ms"] * 1e3))
         write_output(output_path, [model.to_string(),
                                    json.dumps({"encoder": enc.state_dict()})])
         if conf.get("prediction.mode") == "validation":

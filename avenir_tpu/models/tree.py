@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import json
+import time
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -42,6 +43,24 @@ from avenir_tpu.ops import agg, info
 from avenir_tpu.utils.metrics import ConfusionMatrix, Counters
 
 ALGORITHMS = ("entropy", "giniIndex", "hellingerDistance", "classConfidenceRatio")
+
+# level-table / split-histogram strategy (``tree.hist.mode``):
+# ``direct``   — today's path: one full contraction per level, per-split
+#                histograms via the segment einsum;
+# ``cumsum``   — binary-threshold candidates score from ONE bin-axis
+#                cumsum of the level table (info.binary_split_histograms;
+#                a B× cut in per-level scoring work); non-binary
+#                candidate sets keep the einsum;
+# ``subtract`` — cumsum scoring PLUS sibling-subtraction level tables:
+#                per level only the smaller children of each split are
+#                contracted (through the same int8-MXU cross-gram path
+#                when applicable) and each largest sibling is derived by
+#                exact parent-slice subtraction — roughly halving the
+#                per-level gram work for binary trees.
+# Every mode grows trees byte-identical to the ``selection="host"``
+# oracle: counts are exact integer folds either way and tie-breaking is
+# unchanged (asserted across all four algorithms in tests/test_tree.py).
+HIST_MODES = ("direct", "cumsum", "subtract")
 
 
 # ---------------------------------------------------------------------------
@@ -430,6 +449,13 @@ class FlatSplits:
     seg_tab_dev: jax.Array               # [S_pad, B] int32
     attr_dev: jax.Array                  # [S_pad] int32
     nseg_dev: jax.Array                  # [S_pad] int32
+    # binary-threshold metadata for the cumsum fast path: thr_of[s] = the
+    # single sorted threshold of split s (0 on pad rows), meaningful only
+    # when ``all_binary`` — every real split is a two-segment numeric
+    # threshold (codes < t left), i.e. the split.search=binary family
+    thr_of: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, np.int32))
+    thr_dev: Optional[jax.Array] = None
+    all_binary: bool = False
 
     @property
     def num_real(self) -> int:
@@ -455,38 +481,66 @@ def flatten_splits(all_splits: Dict[int, List[CandidateSplit]],
     attr_of = np.zeros(s_pad, np.int32)
     nseg = np.ones(s_pad, np.int32)
     valid = np.zeros(s_pad, bool)
+    thr = np.zeros(s_pad, np.int32)
+    all_binary = s > 0
     for i, sp in enumerate(flat):
         seg_tab[i] = sp.seg_of_bin
         attr_of[i] = sp.attr
         nseg[i] = sp.num_segments
         valid[i] = True
+        t = int(np.argmax(sp.seg_of_bin == 1)) if sp.num_segments == 2 else 0
+        if (sp.kind == "numeric" and sp.num_segments == 2 and t > 0
+                and np.array_equal(
+                    sp.seg_of_bin,
+                    (np.arange(len(sp.seg_of_bin)) >= t).astype(np.int32))):
+            thr[i] = t
+        else:
+            all_binary = False
     return FlatSplits(
         splits=flat, attr_of=attr_of, valid=valid, gmax=gmax, chunk=chunk,
         seg_tab_dev=jnp.asarray(seg_tab), attr_dev=jnp.asarray(attr_of),
-        nseg_dev=jnp.asarray(nseg))
+        nseg_dev=jnp.asarray(nseg), thr_of=thr, thr_dev=jnp.asarray(thr),
+        all_binary=all_binary)
 
 
 def _scored_chunks(table: jax.Array, seg_tab: jax.Array, attr_of: jax.Array,
                    nseg: jax.Array, algorithm: str, gmax: int, chunk: int,
-                   parent_info=None, want_hist: bool = False):
+                   parent_info=None, want_hist: bool = False,
+                   thr: Optional[jax.Array] = None, binary: bool = False):
     """Score every padded candidate split against the device level table in
     ``chunk``-sized blocks under ``lax.map`` (bounds the [s, B, K, C]
     gather working set).  Returns scores [S_pad, K] and, when
-    ``want_hist``, the [S_pad, G, K, C] int32 histograms."""
+    ``want_hist``, the [S_pad, G, K, C] int32 histograms.
+
+    With ``binary`` (the cumsum fast path, ``tree.hist.mode`` cumsum /
+    subtract + an all-binary candidate family), the per-split histogram
+    is two gathers against ONE bin-axis cumsum of the table
+    (:func:`info.binary_split_histograms`) instead of the per-split
+    segment einsum — identical int32 histograms (exact prefix sums), the
+    same block structure and the same ``split_scores`` graph on the same
+    shapes, so scores stay bit-identical to the einsum form."""
     s_pad, b = seg_tab.shape
     nc = s_pad // chunk
     grange = jnp.arange(gmax, dtype=jnp.int32)
+    cum = info.cumulative_level_table(table) if binary else None
+    if binary:
+        assert gmax == 2, "binary cumsum path requires two-segment splits"
 
     def block(args):
-        st, ao, ns = args                                   # [s,B] [s] [s]
-        h = info.split_segment_histograms(table, st, ao, gmax)
+        if binary:
+            th, ao, ns = args                               # [s] [s] [s]
+            h = info.binary_split_histograms(cum, ao, th)
+        else:
+            st, ao, ns = args                               # [s,B] [s] [s]
+            h = info.split_segment_histograms(table, st, ao, gmax)
         mask = grange[None, :] < ns[:, None]                # [s, G]
         sc = split_scores(h.astype(jnp.float32), algorithm,
                           parent_info=parent_info, seg_mask=mask)
         return (sc, h) if want_hist else (sc,)
 
-    out = jax.lax.map(block, (seg_tab.reshape(nc, chunk, b),
-                              attr_of.reshape(nc, chunk),
+    lead = (thr.reshape(nc, chunk) if binary
+            else seg_tab.reshape(nc, chunk, b))
+    out = jax.lax.map(block, (lead, attr_of.reshape(nc, chunk),
                               nseg.reshape(nc, chunk)))
     k = table.shape[2]
     scores = out[0].reshape(s_pad, k)
@@ -496,11 +550,12 @@ def _scored_chunks(table: jax.Array, seg_tab: jax.Array, attr_of: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("algorithm", "gmax", "top_k",
-                                             "chunk"))
+                                             "chunk", "binary"))
 def _device_select_splits(table: jax.Array, seg_tab: jax.Array,
                           attr_of: jax.Array, nseg: jax.Array,
-                          allow: jax.Array, *, algorithm: str, gmax: int,
-                          top_k: int, chunk: int):
+                          allow: jax.Array, thr: Optional[jax.Array] = None,
+                          *, algorithm: str, gmax: int,
+                          top_k: int, chunk: int, binary: bool = False):
     """Device-resident split selection for one frontier level: build every
     candidate's segment histogram from the on-device [F, B, K, C] table
     (``info.split_segment_histograms`` — a device einsum, not a host numpy
@@ -517,7 +572,7 @@ def _device_select_splits(table: jax.Array, seg_tab: jax.Array,
     Disallowed (strategy-masked) and pad candidates come back as −inf.
     """
     scores, _ = _scored_chunks(table, seg_tab, attr_of, nseg,
-                               algorithm, gmax, chunk)
+                               algorithm, gmax, chunk, thr=thr, binary=binary)
     scores = jnp.where(allow[:, None] & ~jnp.isnan(scores), scores, -jnp.inf)
     vals, idx = jax.lax.top_k(scores.T, top_k)              # [K, P] each
     k = table.shape[2]
@@ -531,11 +586,14 @@ def _device_select_splits(table: jax.Array, seg_tab: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("algorithm", "gmax", "chunk",
-                                             "has_parent", "want_hist"))
+                                             "has_parent", "want_hist",
+                                             "binary"))
 def _device_score_all(table: jax.Array, seg_tab: jax.Array,
                       attr_of: jax.Array, nseg: jax.Array, parent_info,
+                      thr: Optional[jax.Array] = None,
                       *, algorithm: str, gmax: int, chunk: int,
-                      has_parent: bool, want_hist: bool = False):
+                      has_parent: bool, want_hist: bool = False,
+                      binary: bool = False):
     """Score EVERY candidate split on device and return (scores [S_pad, K],
     hist [S_pad, G, K, C] or None) — the batched entry behind the
     ClassPartitionGenerator job, whose contract is the full scored list
@@ -545,7 +603,32 @@ def _device_score_all(table: jax.Array, seg_tab: jax.Array,
     never the [F, B, K, C] table."""
     return _scored_chunks(table, seg_tab, attr_of, nseg, algorithm, gmax,
                           chunk, parent_info=parent_info if has_parent
-                          else None, want_hist=want_hist)
+                          else None, want_hist=want_hist, thr=thr,
+                          binary=binary)
+
+
+@jax.jit
+def _assemble_subtract_table(direct_table: jax.Array, prev_table: jax.Array,
+                             dslot: jax.Array, pslot: jax.Array,
+                             sib_mat: jax.Array) -> jax.Array:
+    """Sibling-subtraction level-table assembly (``tree.hist.mode``
+    subtract): the frontier's [F, B, K, C] table from the [F, B, D, C]
+    DIRECT table (only the smaller children of each split were
+    contracted) plus the parent level's resident table.
+
+    Node k is either direct (``dslot[k]`` ≥ 0 → its own contraction
+    slice) or derived: its parent's previous-level slice
+    (``pslot[k]``) minus the sum of its directly-contracted siblings
+    (``sib_mat[k]`` one-hot over direct slots).  Every row of a split
+    parent routes to exactly one child segment and label-invalid rows
+    are excluded identically from parent and child counts, so the
+    int32 subtraction is EXACT — the derived slice equals the direct
+    contraction bit-for-bit (asserted in tests/test_tree.py)."""
+    direct_part = direct_table[:, :, jnp.maximum(dslot, 0), :]
+    parent_part = prev_table[:, :, jnp.maximum(pslot, 0), :]
+    sib_sum = jnp.einsum("fbdc,kd->fbkc", direct_table, sib_mat)
+    return jnp.where((dslot >= 0)[None, None, :, None],
+                     direct_part, parent_part - sib_sum)
 
 
 # ---------------------------------------------------------------------------
@@ -572,17 +655,36 @@ class DecisionTreeModel:
     class_values: List[str]
     max_bins: int
     algorithm: str
+    # the CONFIGURED depth / segment caps the tree was grown under (None
+    # on legacy artifacts).  predict_shape_signature buckets on these,
+    # not on what the tree happened to grow, so a retrain at the same
+    # caps that grows shallower or narrower still lands in the same
+    # compiled-walker bucket
+    depth_cap: Optional[int] = None
+    split_cap: Optional[int] = None
 
     # compiled arrays for jitted prediction
-    def compile_arrays(self):
+    def compile_arrays(self, pad: bool = False):
+        """Flat device arrays for the jitted walker.  With ``pad``, the
+        node and segment axes round up to power-of-two buckets
+        (:func:`_pow2_bucket`): pad node rows are self-loop leaves with a
+        zero distribution and are unreachable from the root, so padded
+        and unpadded walks are byte-identical — what lets a retrained
+        tree of a different size land in the SAME compiled scoring
+        program (see :func:`predict_fn`; the StreamGraft
+        drift→retrain→hot-swap path relies on it for zero swap
+        recompiles)."""
         m = len(self.nodes)
         gmax = max([n.split.num_segments for n in self.nodes if n.split] or [1])
-        attr = np.full(m, 0, np.int32)
-        is_leaf = np.zeros(m, bool)
-        seg_table = np.zeros((m, self.max_bins), np.int32)
-        child = np.tile(np.arange(m, dtype=np.int32)[:, None], (1, gmax))
+        if pad:
+            _dp, mp, gp, _b, _c = predict_shape_signature(self)
+        else:
+            mp, gp = m, gmax
+        attr = np.full(mp, 0, np.int32)
+        seg_table = np.zeros((mp, self.max_bins), np.int32)
+        child = np.tile(np.arange(mp, dtype=np.int32)[:, None], (1, gp))
         c = len(self.class_values)
-        distr = np.zeros((m, c), np.float32)
+        distr = np.zeros((mp, c), np.float32)
         for n in self.nodes:
             tot = max(n.class_counts.sum(), 1.0)
             distr[n.node_id] = n.class_counts / tot
@@ -591,8 +693,6 @@ class DecisionTreeModel:
                 seg_table[n.node_id] = n.split.seg_of_bin
                 for g, ch in enumerate(n.children):
                     child[n.node_id, g] = ch
-            else:
-                is_leaf[n.node_id] = True
         return (jnp.asarray(attr), jnp.asarray(seg_table), jnp.asarray(child),
                 jnp.asarray(distr))
 
@@ -606,6 +706,8 @@ class DecisionTreeModel:
             "class_values": self.class_values,
             "max_bins": self.max_bins,
             "algorithm": self.algorithm,
+            "depth_cap": self.depth_cap,
+            "split_cap": self.split_cap,
             "nodes": [
                 {
                     "id": n.node_id, "depth": n.depth,
@@ -634,8 +736,12 @@ class DecisionTreeModel:
                     sp["num_segments"], sp["key"]),
                 children=list(d["children"]), score=d["score"],
             ))
+        dcap = obj.get("depth_cap")
+        scap = obj.get("split_cap")
         return cls(nodes=nodes, class_values=list(obj["class_values"]),
-                   max_bins=int(obj["max_bins"]), algorithm=obj["algorithm"])
+                   max_bins=int(obj["max_bins"]), algorithm=obj["algorithm"],
+                   depth_cap=None if dcap is None else int(dcap),
+                   split_cap=None if scap is None else int(scap))
 
     def to_string(self) -> str:
         return json.dumps(self.to_json())
@@ -645,21 +751,75 @@ class DecisionTreeModel:
         return cls.from_json(json.loads(s))
 
 
-def predict_fn(model: DecisionTreeModel):
-    """Build a jitted [N,F] codes → ([N] class idx, [N,C] distr) walker."""
-    attr, seg_table, child, distr = model.compile_arrays()
-    depth = max(model.max_depth, 1)
+def _pow2_bucket(n: int) -> int:
+    """Smallest power of two ≥ n (n ≥ 1 → 1, 2, 4, 8, …)."""
+    return 1 << max(n - 1, 0).bit_length()
 
-    @jax.jit
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _tree_walk(attr: jax.Array, seg_table: jax.Array, child: jax.Array,
+               distr: jax.Array, codes: jax.Array, *, depth: int):
+    """The ONE compiled tree walker, shared across models: the tree
+    arrays are ARGUMENTS (not closure constants), so the jit cache keys
+    on their shapes — two trees with the same padded bucket shapes and
+    depth bucket reuse the same executable.  Extra ``depth`` iterations
+    past a tree's real depth are identities (leaves self-loop via the
+    child table's diagonal default)."""
+    node = jnp.zeros(codes.shape[0], jnp.int32)
+    for _ in range(depth):
+        a = attr[node]                                           # [N]
+        code = jnp.take_along_axis(codes, a[:, None], axis=1)[:, 0]
+        seg = seg_table[node, code]
+        node = child[node, seg]
+    d = distr[node]
+    return jnp.argmax(d, axis=-1).astype(jnp.int32), d
+
+
+def predict_shape_signature(model: DecisionTreeModel) -> tuple:
+    """The padded compile-shape bucket of :func:`predict_fn`'s walker —
+    (depth bucket, node bucket, segment bucket, max_bins, classes).  Two
+    models with equal signatures share the compiled scoring program for
+    any given batch shape; serving uses this as part of its compile key
+    so a hot-swap onto an equal-signature tree provably compiles
+    nothing.
+
+    The depth and segment buckets come from the CONFIGURED caps the tree
+    was grown under (``depth_cap`` / ``split_cap``; the grown shape only
+    on legacy artifacts without them), with the segment bucket floored
+    at 4 — a retrained tree that happened to grow shallower or narrower
+    (e.g. only binary splits under a 5-way cap) must not land in a
+    different bucket than its predecessor.  The node bucket is derived
+    from the FULL-tree node bound of the depth/segment buckets (capped
+    at 4096 so deep exhaustive trees don't inflate the padded arrays),
+    not from this tree's own node count — so a drift→retrain of the same
+    family at the same caps lands in the SAME bucket regardless of what
+    it happened to grow."""
+    m = len(model.nodes)
+    gmax = max([n.split.num_segments for n in model.nodes if n.split] or [1])
+    dp = _pow2_bucket(max(model.depth_cap or model.max_depth, 1))
+    gp = max(_pow2_bucket(max(model.split_cap or 1, gmax)), 4)
+    full = (gp ** (dp + 1) - 1) // (gp - 1)
+    mp = _pow2_bucket(max(m, min(full, 4096)))
+    return (dp, mp, gp, model.max_bins, len(model.class_values))
+
+
+def predict_fn(model: DecisionTreeModel, pad_shapes: bool = True):
+    """Build a jitted [N,F] codes → ([N] class idx, [N,C] distr) walker.
+
+    With ``pad_shapes`` (default) the tree arrays pad to power-of-two
+    node/segment buckets and the walk depth rounds up to a power-of-two
+    bucket, so a retrained tree of a different depth/size within the
+    same buckets REUSES the compiled program (:func:`_tree_walk` keys on
+    shapes, not identity) — predictions are byte-identical either way
+    (pad nodes unreachable, extra levels identity self-loops)."""
+    attr, seg_table, child, distr = model.compile_arrays(pad=pad_shapes)
+    if pad_shapes:
+        depth = predict_shape_signature(model)[0]
+    else:
+        depth = max(model.max_depth, 1)
+
     def walk(codes: jax.Array):
-        node = jnp.zeros(codes.shape[0], jnp.int32)
-        for _ in range(depth):
-            a = attr[node]                                           # [N]
-            code = jnp.take_along_axis(codes, a[:, None], axis=1)[:, 0]
-            seg = seg_table[node, code]
-            node = child[node, seg]
-        d = distr[node]
-        return jnp.argmax(d, axis=-1).astype(jnp.int32), d
+        return _tree_walk(attr, seg_table, child, distr, codes, depth=depth)
 
     return walk
 
@@ -709,6 +869,18 @@ class DecisionTree:
       segments) — the candidate family sklearn's DecisionTreeClassifier
       searches over ordinal-encoded inputs, scored by the same kernels;
       the apples-to-apples benchmarking mode.
+
+    ``hist_mode`` picks the level-table / split-histogram strategy (see
+    :data:`HIST_MODES`): ``direct`` (default, today's path), ``cumsum``
+    (binary-threshold candidates score from one bin-axis cumsum of the
+    level table — a B× cut in per-level scoring work; exhaustive
+    multi-way search keeps its einsum), ``subtract`` (cumsum scoring
+    plus sibling-subtraction level tables — only the smaller children
+    of each split are contracted, the largest sibling derives by exact
+    parent-slice subtraction, roughly halving per-level gram work).
+    All three grow byte-identical trees; ``cumsum``/``subtract``
+    scoring applies on the device-selection path (the ``host`` oracle
+    always folds the direct form).
     """
 
     def __init__(
@@ -728,6 +900,8 @@ class DecisionTree:
         mesh=None,
         selection: str = "device",
         split_search: str = "exhaustive",
+        hist_mode: str = "direct",
+        collect_phase_stats: bool = False,
     ):
         if algorithm not in ALGORITHMS:
             raise ValueError(f"unknown algorithm {algorithm!r}; known: {ALGORITHMS}")
@@ -737,8 +911,17 @@ class DecisionTree:
         if split_search not in ("exhaustive", "binary"):
             raise ValueError(f"unknown split_search {split_search!r}; "
                              "known: exhaustive, binary")
+        if hist_mode not in HIST_MODES:
+            raise ValueError(f"unknown hist_mode {hist_mode!r}; "
+                             f"known: {HIST_MODES}")
         self.selection = selection
         self.split_search = split_search
+        self.hist_mode = hist_mode
+        # per-level phase breakdown (table-build / score+select /
+        # partition wall ms) — opt-in because honest phase timings need
+        # a device sync per phase; read ``self.level_stats`` after fit
+        self.collect_phase_stats = collect_phase_stats
+        self.level_stats: List[dict] = []
         self.algorithm = algorithm
         self.max_depth = max_depth
         self.min_node_size = min_node_size
@@ -794,6 +977,12 @@ class DecisionTree:
                 if self.selection == "device" else None)
         use_device_sel = flat is not None and flat.num_real > 0
 
+        # cumsum fast path: every candidate is one sorted threshold on the
+        # bin grid (split.search=binary), so per-level scoring runs on the
+        # cumulative level table instead of the per-split segment einsum
+        use_cum = (use_device_sel and flat.all_binary
+                   and self.hist_mode in ("cumsum", "subtract"))
+
         root_counts = np.bincount(ds.labels, minlength=c).astype(np.float64)
         nodes: List[TreeNode] = [TreeNode(0, 0, root_counts)]
         # the [N] per-row node assignment lives ON DEVICE for the whole
@@ -803,28 +992,70 @@ class DecisionTree:
         # level that dominated induction wall time on the dev rig
         node_dev = jnp.zeros(labels_dev.shape[0], jnp.int32)
         frontier = [0]
+        # sibling-subtraction bookkeeping (hist_mode="subtract"): the
+        # previous level's resident table plus the host-side plan mapping
+        # each frontier child to a direct contraction slot or a derived
+        # (parent − direct siblings) slice
+        use_subtract = self.hist_mode == "subtract"
+        prev_table_dev = None
+        sub_plan = None     # (remap_direct, dslot, pslot, sib_mat, kd)
+        collect = self.collect_phase_stats
+        self.level_stats = []
+
+        def build_table(local_ids, k_slots):
+            """The ONE level contraction entry (shared by the full-frontier
+            and direct-slot builds): cross-gram kernel when the selector
+            width qualifies, einsum otherwise.  Returns (table, on_kernel)."""
+            cross = use_cross and pallas_hist.cross_applicable(
+                ds.num_binned, ds.max_bins, k_slots * c)
+            if cross:
+                return _level_table_cross(
+                    codes_t_dev, local_ids, labels_dev, k_slots, c,
+                    ds.max_bins), True
+            return node_bin_class_counts(
+                codes_dev, local_ids, labels_dev, k_slots, c,
+                ds.max_bins), False
 
         for depth in range(self.max_depth):
             if not frontier:
                 break
+            t_lv = time.perf_counter()
             k = len(frontier)
             # remap frontier ids to 0..k-1 for the level contraction
             remap = np.full(len(nodes), -1, np.int32)
             for i, nid in enumerate(frontier):
                 remap[nid] = i
             remap_dev = jnp.asarray(remap)
-            local_node_dev = _remap_nodes(node_dev, remap_dev)
             # the [F, B, K, C] level table stays ON DEVICE; under device
             # selection it is never fetched — only the chosen-split
             # descriptors are
-            if use_cross and pallas_hist.cross_applicable(
-                    ds.num_binned, ds.max_bins, k * c):
-                table_dev = _level_table_cross(
-                    codes_t_dev, local_node_dev, labels_dev, k, c,
-                    ds.max_bins)
+            k_contracted = k
+            if use_subtract and sub_plan is not None:
+                # contract ONLY the direct (smaller-sibling) slots — for
+                # binary trees ~half the gram work — and derive each
+                # largest sibling by exact parent-slice subtraction
+                remap_direct, dslot, pslot, sib_mat, kd = sub_plan
+                k_contracted = kd
+                local_direct = _remap_nodes(node_dev,
+                                            jnp.asarray(remap_direct))
+                direct_dev, cross_lv = build_table(local_direct, kd)
+                table_dev = _assemble_subtract_table(
+                    direct_dev, prev_table_dev, jnp.asarray(dslot),
+                    jnp.asarray(pslot), jnp.asarray(sib_mat))
             else:
-                table_dev = node_bin_class_counts(
-                    codes_dev, local_node_dev, labels_dev, k, c, ds.max_bins)
+                local_node_dev = _remap_nodes(node_dev, remap_dev)
+                table_dev, cross_lv = build_table(local_node_dev, k)
+            if use_subtract:
+                # only the subtract path ever reads the previous level's
+                # table; retaining it otherwise would hold a second dead
+                # [F, B, K, C] buffer in HBM per level
+                prev_table_dev = table_dev
+            if collect:
+                # honest per-phase walls need a barrier per phase; this
+                # probe mode is opt-in (collect_phase_stats /
+                # tree.hist.phase.stats), never the production fit loop
+                jax.block_until_ready(table_dev)   # graftlint: disable=GL005
+                t_tab = time.perf_counter()
 
             attrs_lv = self._attrs_for_node(rng, ds.num_binned)
             best_per_node: List[List[Tuple[float, CandidateSplit, np.ndarray]]] = [
@@ -839,8 +1070,9 @@ class DecisionTree:
                 vals, idx, whist = jax.device_get(_device_select_splits(
                     table_dev, flat.seg_tab_dev, flat.attr_dev,
                     flat.nseg_dev, jnp.asarray(flat.allow_vector(attrs_lv)),
+                    flat.thr_dev if use_cum else None,
                     algorithm=self.algorithm, gmax=flat.gmax, top_k=top_k,
-                    chunk=flat.chunk))
+                    chunk=flat.chunk, binary=use_cum))
                 for ki in range(k):
                     for p in range(top_k):
                         s = float(vals[ki, p])
@@ -862,6 +1094,7 @@ class DecisionTree:
             new_frontier: List[int] = []
             attr_arr = np.zeros(k, np.int32)
             child_tab = np.full((k, ds.max_bins), -1, np.int32)
+            split_records: List[Tuple[int, List[int], np.ndarray]] = []
             for ki, nid in enumerate(frontier):
                 node = nodes[nid]
                 cands = sorted(best_per_node[ki], key=lambda t: -t[0])[:max(self.top_n, 1)]
@@ -893,15 +1126,92 @@ class DecisionTree:
                 child_ids = np.asarray(node.children, np.int32)
                 attr_arr[ki] = sp.attr
                 child_tab[ki] = child_ids[sp.seg_of_bin]
+                split_records.append((ki, list(node.children), seg_counts))
+            if collect:
+                t_sel = time.perf_counter()
             # no next level (or nothing split) → the updated vector would
             # never be read; skip the dispatch
             if new_frontier and (child_tab >= 0).any():
                 node_dev = _apply_level_partition(
                     codes_dev, node_dev, remap_dev,
                     jnp.asarray(attr_arr), jnp.asarray(child_tab))
+                if collect:
+                    # see the table-phase barrier note above
+                    jax.block_until_ready(node_dev)  # graftlint: disable=GL005
+            sub_plan = (self._subtract_plan(split_records, new_frontier,
+                                            len(nodes))
+                        if use_subtract and new_frontier else None)
+            if collect:
+                t_end = time.perf_counter()
+                self.level_stats.append({
+                    "level": depth, "frontier": k,
+                    "contracted_slots": k_contracted,
+                    # the contraction's true dot width ON THE PATH THIS
+                    # LEVEL TOOK: the kernel pads the selector to
+                    # 128-lane tiles, so halved slots only halve the dot
+                    # once K·C crosses a lane boundary (einsum fallback
+                    # scales with K·C directly)
+                    "sel_width": (pallas_hist.cross_sel_width(
+                        k_contracted * c) if cross_lv else
+                        k_contracted * c),
+                    "table_ms": round((t_tab - t_lv) * 1e3, 3),
+                    "select_ms": round((t_sel - t_tab) * 1e3, 3),
+                    "partition_ms": round((t_end - t_sel) * 1e3, 3)})
             frontier = new_frontier
         return DecisionTreeModel(nodes=nodes, class_values=list(ds.class_values),
-                                 max_bins=ds.max_bins, algorithm=self.algorithm)
+                                 max_bins=ds.max_bins, algorithm=self.algorithm,
+                                 depth_cap=self.max_depth,
+                                 split_cap=(2 if self.split_search == "binary"
+                                            else self.max_split))
+
+    @staticmethod
+    def _subtract_plan(split_records, new_frontier, num_nodes: int):
+        """Host-side plan (tiny) for the next level's sibling-subtraction
+        table: per split parent with frontier children, pick the
+        largest-mass segment g* (stable: lowest g on ties) as the DERIVED
+        child and mark every other segment's child a DIRECT contraction
+        slot (settled siblings included — the subtraction needs them);
+        when the g* child itself is settled, only the frontier children
+        are contracted (nothing needs deriving there).  Returns
+        (remap_direct [num_nodes] abs id → slot, dslot [K] (−1 =
+        derived), pslot [K] parent's previous-level local index,
+        sib_mat [K, D] direct-sibling one-hot, D)."""
+        fs = set(new_frontier)
+        direct_ids: List[int] = []
+        dslot_of: Dict[int, int] = {}
+        derived_info: Dict[int, Tuple[int, List[int]]] = {}
+        for ki, child_ids, masses in split_records:
+            in_f = [cid for cid in child_ids if cid in fs]
+            if not in_f:
+                continue
+            gstar = int(np.argmax(np.asarray(masses)))
+            gstar_child = child_ids[gstar]
+            if gstar_child in fs:
+                members = [cid for g, cid in enumerate(child_ids)
+                           if g != gstar]
+                derived_info[gstar_child] = (ki, members)
+            else:
+                members = in_f
+            for cid in members:
+                dslot_of[cid] = len(direct_ids)
+                direct_ids.append(cid)
+        kd = len(direct_ids)
+        kf = len(new_frontier)
+        remap_direct = np.full(num_nodes, -1, np.int32)
+        for cid, sl in dslot_of.items():
+            remap_direct[cid] = sl
+        dslot = np.full(kf, -1, np.int32)
+        pslot = np.zeros(kf, np.int32)
+        sib_mat = np.zeros((kf, kd), np.int32)
+        for k2, cid in enumerate(new_frontier):
+            if cid in derived_info:
+                kp, members = derived_info[cid]
+                pslot[k2] = kp
+                for m in members:
+                    sib_mat[k2, dslot_of[m]] = 1
+            else:
+                dslot[k2] = dslot_of[cid]
+        return remap_direct, dslot, pslot, sib_mat, kd
 
     def predict(self, model: DecisionTreeModel, ds: EncodedDataset,
                 validate: bool = False, pos_class: Optional[str] = None):

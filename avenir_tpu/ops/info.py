@@ -47,6 +47,38 @@ def _safe_log(x: jax.Array) -> jax.Array:
     return jnp.log(jnp.where(x > 0, x, 1.0))
 
 
+def cumulative_level_table(table: jax.Array) -> jax.Array:
+    """[F, B, K, C] level table → its inclusive prefix sum over the bin
+    axis: ``cum[f, b] = Σ_{b' ≤ b} table[f, b']``.  Exact in integer
+    dtypes (prefix addition commutes with the einsum fold), so every
+    statistic derived from it is bit-identical to one derived from the
+    raw table.  This is the ONE O(F·B·K·C) pass that replaces the
+    per-threshold einsum for binary-threshold split search — every sorted
+    threshold's left histogram is a single row of ``cum``
+    (:func:`binary_split_histograms`)."""
+    return jnp.cumsum(table, axis=1)
+
+
+def binary_split_histograms(cum: jax.Array, attr_of: jax.Array,
+                            thr_of: jax.Array) -> jax.Array:
+    """Cumulative-histogram binary splits: ``cum`` [F, B, K, C] (the
+    inclusive bin prefix sum of the level table), ``attr_of`` [S] owning
+    attribute per split, ``thr_of`` [S] bin threshold (codes < t go
+    left) → [S, 2, K, C] segment×class histograms, O(S·K·C) gathers
+    instead of the O(S·B·K·C) ``sgb,sbkc->sgkc`` einsum of
+    :func:`split_segment_histograms` — for S ≈ F·(B−1) binary candidates
+    a B× cut in per-level scoring work.
+
+    left = cum[a, t−1] (all bins < t), right = node total − left
+    (node total = cum[a, B−1]).  Integer subtraction of exact integer
+    prefix sums: the result is bit-identical to the einsum form's
+    histogram for the same (a, t), which the byte-identity property
+    tests assert directly."""
+    left = cum[attr_of, thr_of - 1]                    # [S, K, C]
+    total = cum[attr_of, -1]                           # [S, K, C]
+    return jnp.stack([left, total - left], axis=1)     # [S, 2, K, C]
+
+
 def split_segment_histograms(table: jax.Array, seg_tab: jax.Array,
                              attr_of: jax.Array, gmax: int) -> jax.Array:
     """Batched device scoring entry for tree induction: the [F, B, K, C]

@@ -506,6 +506,17 @@ def _cross_kernel(codes_ref, sel_ref, out_ref, *, f: int, b: int, jcp: int,
 MAX_SEL_CROSS = 1024
 
 
+def cross_sel_width(num_sel: int) -> int:
+    """Padded selector lane width of the cross gram's dot (the Y side of
+    XᵀY pads to whole 128-lane tiles).  The dot work scales linearly
+    with this, which is what makes it the honest unit for the decision
+    tree's sibling-subtraction accounting (round 13): halving the
+    contracted frontier slots only shrinks the kernel dot when K·C
+    crosses a 128-lane boundary — the per-level ``sel_width`` in
+    ``DecisionTree.level_stats`` reports exactly that."""
+    return _ru(max(num_sel, 1), 128)
+
+
 def cross_applicable(num_feat: int, num_bins: int, num_sel: int) -> bool:
     """Gate for the cross kernel: the X side obeys the joint-gram width
     cap and the selector side stays small (its padded lane width scales

@@ -279,6 +279,13 @@ class TreeServable(ServableModel):
         self.enc = encoder
         self.delim = delim
         self.walk = dtree.predict_fn(model)   # holds device-resident tables
+        # the walker's arrays pad to pow-2 depth/node/segment buckets and
+        # the compiled program keys on those SHAPES (models/tree.py::
+        # _tree_walk), so the compile key carries the bucket signature:
+        # a hot-swap onto a retrained tree inside the same buckets is
+        # provably recompile-free (the monitor sees no fresh key and the
+        # walker's jit cache is reused), while a bucket change is counted
+        self._shape_sig = dtree.predict_shape_signature(model)
 
     @classmethod
     def from_conf(cls, conf: JobConfig) -> "TreeServable":
@@ -306,7 +313,7 @@ class TreeServable(ServableModel):
 
         rows = _parse_rows(lines, self.delim, self.enc.max_ordinal(False))
         ds = _pad_ds(self.enc.transform(rows, with_labels=False), pad_to)
-        self.compile_keys.add((pad_to,))
+        self.compile_keys.add((pad_to,) + self._shape_sig)
         pred, _distr = self.walk(jnp.asarray(ds.codes))
         pred = np.asarray(pred)
         return [self.delim.join(list(r) + [self.model.class_values[int(p)]])
@@ -315,7 +322,7 @@ class TreeServable(ServableModel):
     def warmup(self, pad_to: int) -> None:
         import jax.numpy as jnp
 
-        self.compile_keys.add((pad_to,))
+        self.compile_keys.add((pad_to,) + self._shape_sig)
         self.walk(jnp.asarray(_blank_ds(self.enc, pad_to).codes))
 
 

@@ -16,7 +16,13 @@ Families and shapes (reference-derived):
                sorted-threshold binary splits over ordinal codes, the
                SAME candidate family sklearn scans, so its vs_baseline
                is the apples-to-apples ratio (device-resident split
-               selection on both tree rows).
+               selection on both tree rows).  Runs
+               ``tree.hist.mode=subtract`` by default (round 13
+               TreeGraft: cumulative-histogram scoring +
+               sibling-subtraction level tables, byte-identical trees);
+               every tree row carries a ``hist_mode`` tag, a fresh
+               matmul canary per pass, and a per-level phase breakdown
+               so captures stay attributable.
 - ``viterbi``  batch Viterbi decode, email-marketing-tutorial shape
                (``resource/tutorial_opt_email_marketing.txt:15-18``):
                80k sequences × 210 observations; seqs/s.  Baseline: the
@@ -79,18 +85,33 @@ def _tree_data(n: int):
 
 
 def bench_tree(passes: int, n: int = 2_000_000, baseline_sub: int = 100_000,
-               search: str = "exhaustive"):
+               search: str = "exhaustive", hist_mode: str = "direct"):
     from avenir_tpu.models import tree as dtree
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
 
     ds, is_cat = _tree_data(n)
     builder = dtree.DecisionTree(algorithm="entropy", max_depth=4,
-                                 max_split=3, split_search=search)
+                                 max_split=3, split_search=search,
+                                 hist_mode=hist_mode)
     vals = []
+    canary_per_pass = []
     model = builder.fit(ds, is_categorical=is_cat)       # compile + warm
     for _ in range(passes):
+        # rig-state canary BEFORE each tree pass (per the bench.py
+        # convention): a slow pass with an inflated canary is rig
+        # contention, a slow pass with a flat canary is a tree regression
+        # — the attribution the hist-mode comparison needs
+        canary_per_pass.append(matmul_canary_ms())
         t0 = time.perf_counter()
         model = builder.fit(ds, is_categorical=is_cat)
         vals.append(n / (time.perf_counter() - t0))
+    # one extra instrumented fit for the per-level phase breakdown
+    # (table-build / score+select / partition wall ms) — separate from the
+    # timed passes because honest phase walls need a sync per phase
+    probe = dtree.DecisionTree(algorithm="entropy", max_depth=4,
+                               max_split=3, split_search=search,
+                               hist_mode=hist_mode, collect_phase_stats=True)
+    probe.fit(ds, is_categorical=is_cat)
     if search == "binary":
         note = ("apples-to-apples: sorted-threshold binary splits on "
                 "ordinal codes — the SAME candidate family sklearn's "
@@ -107,6 +128,9 @@ def bench_tree(passes: int, n: int = 2_000_000, baseline_sub: int = 100_000,
             "n_rows": n, "max_depth": 4, "nodes": len(model.nodes),
             "shape": "retarget", "split_search": search,
             "selection_path": builder.selection,
+            "hist_mode": hist_mode,
+            "canary_per_pass_ms": [round(c, 2) for c in canary_per_pass],
+            "level_phases": probe.level_stats,
             "baseline_rows_per_sec": round(baseline_tree(ds, baseline_sub), 1),
             "baseline": f"sklearn DecisionTreeClassifier.fit depth<=4 on "
                         f"{baseline_sub} rows, single core",
@@ -114,10 +138,16 @@ def bench_tree(passes: int, n: int = 2_000_000, baseline_sub: int = 100_000,
 
 
 def bench_tree_binary(passes: int, n: int = 2_000_000,
-                      baseline_sub: int = 100_000):
+                      baseline_sub: int = 100_000,
+                      hist_mode: str = "subtract"):
     """`split.search=binary` benchmarked against the same sklearn anchor —
-    both sides search sorted-threshold binary splits over ordinal codes."""
-    return bench_tree(passes, n, baseline_sub, search="binary")
+    both sides search sorted-threshold binary splits over ordinal codes.
+    Defaults to `tree.hist.mode=subtract` (cumulative-histogram scoring +
+    sibling-subtraction level tables — byte-identical trees, the
+    TreeGraft fast path this row exists to measure; the `hist_mode` tag
+    keeps every capture attributable)."""
+    return bench_tree(passes, n, baseline_sub, search="binary",
+                      hist_mode=hist_mode)
 
 
 def baseline_tree(ds, sub: int) -> float:
@@ -411,9 +441,14 @@ def families_summary(passes: int = 2) -> dict:
     out = {}
     for name in ("tree", "tree_binary", "viterbi", "lr", "cramer"):
         line = family_line(name, passes=passes, reduced=True)
+        # level_phases rides into the driver artifact: the tree rows pay
+        # one instrumented fit for it, so dropping it here would waste
+        # that fit — and the per-level table/select/partition ms is the
+        # attribution the hist-mode comparison needs
         out[name] = {k: line[k] for k in
                      ("metric", "value", "unit", "vs_baseline", "note",
-                      "selection_path", "split_search")
+                      "selection_path", "split_search", "hist_mode",
+                      "canary_per_pass_ms", "level_phases")
                      if k in line}
         bk = next((k for k in line if k.startswith("baseline_")
                    and k.endswith("_per_sec")), None)

@@ -364,13 +364,18 @@ def test_whole_tree_zero_nonbaselined_findings():
     # tests/test_shard.py + shard_worker.py likewise (round 12) — the
     # ShardGraft byte-identity gate drives the sharded fold loop, where an
     # undocumented shard.* key (GL004) or a sync-in-loop (GL005) would hide
+    # tests/test_tree.py likewise (round 13) — the TreeGraft hist-mode
+    # byte-identity gate drives the per-level selection loop, where an
+    # undocumented tree.hist.* key (GL004) or a sync-in-loop (GL005)
+    # would hide
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
          str(REPO / "tests" / "test_telemetry.py"),
          str(REPO / "tests" / "test_stream.py"),
          str(REPO / "tests" / "test_shard.py"),
-         str(REPO / "tests" / "shard_worker.py")],
+         str(REPO / "tests" / "shard_worker.py"),
+         str(REPO / "tests" / "test_tree.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

@@ -140,6 +140,56 @@ def test_tree_parity(ws):
         b.close()
 
 
+def test_tree_hot_swap_same_bucket_zero_recompiles(ws):
+    """TreeGraft serving contract: predict_fn pads tree arrays to pow-2
+    depth/node/segment buckets and the walker keys on SHAPES, so a
+    drift→retrain→hot-swap onto a tree of a different depth (same depth
+    bucket) reuses the compiled scoring program — zero recompiles counted
+    by the existing CompileKeyMonitor even with the swap barrier's warmup
+    DISABLED, and the module-level walker's jit cache does not grow."""
+    from avenir_tpu.core.csv_io import write_csv as _write_csv
+    from avenir_tpu.models import tree as dtree
+    from avenir_tpu.serving.registry import TreeServable
+
+    j, retarget = ws["j"], ws["retarget"]
+    # retrained artifact: different data, depth 3 (buckets with depth 4)
+    _write_csv(j("rdata2.csv"), generate_retarget(900, seed=17))
+    get_job("DecisionTreeBuilder").run(
+        JobConfig({**retarget, "max.depth": "3"}),
+        j("rdata2.csv"), j("tree_model_v2"))
+    b, _, registry = _batcher({**retarget,
+                               "tree.model.file.path": j("tree_model"),
+                               "serve.models": "tree",
+                               "serve.bucket.sizes": "1,8"})
+    try:
+        lines = read_lines(j("rdata.csv"))[:16]
+        _serve_all(b, "tree", lines, burst=4)
+        entry_v2 = TreeServable.from_conf(JobConfig(
+            {**retarget, "tree.model.file.path": j("tree_model_v2")}))
+        assert entry_v2._shape_sig == registry.get("tree")._shape_sig
+        cache = (dtree._tree_walk._cache_size()
+                 if hasattr(dtree._tree_walk, "_cache_size") else None)
+        # warm=False: the barrier would hide a recompile by paying it on
+        # the caller thread — with shape-stable buckets there is nothing
+        # to pay, which is exactly what the monitor now proves
+        assert b.swap("tree", entry_v2, warm=False) == 2
+        served = _serve_all(b, "tree", lines, burst=4)
+        assert b.counters.get("Serving.tree", "recompiles") == 0
+        assert b.counters.get("Serving.tree", "swaps") == 1
+        if cache is not None:
+            assert dtree._tree_walk._cache_size() == cache, \
+                "hot-swap compiled a fresh walker despite equal buckets"
+        # post-swap responses come from the NEW model (parity with its
+        # own batch predictor)
+        conf2 = JobConfig({**retarget,
+                           "tree.model.file.path": j("tree_model_v2")})
+        get_job("DecisionTreeBuilder").run(conf2, j("rdata.csv"),
+                                           j("tree_pred_v2"))
+        assert served == read_lines(j("tree_pred_v2"))[:16]
+    finally:
+        b.close()
+
+
 def test_viterbi_parity_state_sequences(ws):
     j = ws["j"]
     seq_lines = ["u1,1,x,y,x", "u2,2,y", "u3,3,x,y,x,x,y", "u4,4,y,x",

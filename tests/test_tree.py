@@ -379,6 +379,61 @@ def test_class_partition_generator_device_matches_host(tmp_path):
     assert dev_lines and dev_lines == host_lines
 
 
+def test_class_partition_generator_binary_cumsum_matches_host(tmp_path):
+    """The job path's cumsum fast path (split.search=binary +
+    tree.hist.mode=cumsum) must emit a split file line-identical to the
+    host pipeline's — scores formatted to 6 decimals, segment
+    distributions included."""
+    import json
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    rows = generate_retarget(2000, seed=6)
+    write_csv(str(tmp_path / "d.csv"), rows)
+    (tmp_path / "s.json").write_text(json.dumps(RETARGET_SCHEMA_JSON))
+    base = {"feature.schema.file.path": str(tmp_path / "s.json"),
+            "split.algorithm": "entropy", "split.search": "binary",
+            "output.split.prob": "true"}
+    get_job("ClassPartitionGenerator").run(
+        JobConfig({**base, "tree.hist.mode": "cumsum"}),
+        str(tmp_path / "d.csv"), str(tmp_path / "dev"))
+    get_job("ClassPartitionGenerator").run(
+        JobConfig({**base, "split.selection.path": "host"}),
+        str(tmp_path / "d.csv"), str(tmp_path / "host"))
+    dev_lines = read_lines(str(tmp_path / "dev"))
+    host_lines = read_lines(str(tmp_path / "host"))
+    assert dev_lines and dev_lines == host_lines
+
+
+def test_tree_builder_hist_mode_and_phase_stats(tmp_path):
+    """DecisionTreeBuilder under tree.hist.mode=subtract writes the same
+    model file as the default path, and tree.hist.phase.stats publishes
+    the per-level TreePhase counters."""
+    import json
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    write_csv(str(tmp_path / "d.csv"), generate_retarget(2000, seed=8))
+    (tmp_path / "s.json").write_text(json.dumps(RETARGET_SCHEMA_JSON))
+    base = {"feature.schema.file.path": str(tmp_path / "s.json"),
+            "max.depth": "3", "split.search": "binary"}
+    get_job("DecisionTreeBuilder").run(JobConfig(dict(base)),
+                                       str(tmp_path / "d.csv"),
+                                       str(tmp_path / "m_direct"))
+    c = get_job("DecisionTreeBuilder").run(
+        JobConfig({**base, "tree.hist.mode": "subtract",
+                   "tree.hist.phase.stats": "true"}),
+        str(tmp_path / "d.csv"), str(tmp_path / "m_sub"))
+    assert read_lines(str(tmp_path / "m_direct")) == \
+        read_lines(str(tmp_path / "m_sub"))
+    assert c.get("TreePhase", "level.0.table.us") > 0
+    assert c.get("TreePhase", "level.0.select.us") > 0
+
+
 def test_disease_rule_mining_recovers_age_driver(tmp_path):
     # the disease rule-mining runbook: candidate-split scoring over the
     # planted disease.rb mechanism must rank an age split highest (age has
@@ -448,6 +503,227 @@ def test_tree_builder_meshed_identical_to_single(tmp_path):
         str(tmp_path / "d.csv"), str(tmp_path / "t_single"))
     assert read_lines(str(tmp_path / "t_mesh")) == \
         read_lines(str(tmp_path / "t_single"))
+
+
+def test_hist_mode_validation():
+    with pytest.raises(ValueError, match="hist_mode"):
+        dtree.DecisionTree(hist_mode="nope")
+
+
+def _binary_flat(nbins, pad_bins, chunk=8):
+    """Hand-built padded binary-threshold split arrays over ragged
+    per-attribute bin counts (the flatten_splits layout, minus the
+    CandidateSplit objects)."""
+    seg, attr, thr, nseg = [], [], [], []
+    for a, nb in enumerate(nbins):
+        for t in range(1, nb):
+            seg.append((np.arange(pad_bins) >= t).astype(np.int32))
+            attr.append(a)
+            thr.append(t)
+            nseg.append(2)
+    s = len(seg)
+    s_pad = -(-s // chunk) * chunk
+    while len(seg) < s_pad:
+        seg.append(np.zeros(pad_bins, np.int32))
+        attr.append(0)
+        thr.append(0)
+        nseg.append(1)
+    return (jnp.asarray(np.stack(seg)), jnp.asarray(np.array(attr, np.int32)),
+            jnp.asarray(np.array(thr, np.int32)),
+            jnp.asarray(np.array(nseg, np.int32)),
+            np.array(nseg) == 2, chunk)
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_cumsum_binary_histograms_match_einsum(k):
+    """Property: for every binary threshold, the cumulative-table gather
+    (info.binary_split_histograms) produces int32 histograms EQUAL to the
+    segment einsum's (info.split_segment_histograms) — across ragged
+    per-attribute bin counts and frontier widths incl. a single node."""
+    from avenir_tpu.ops import info
+    rng = np.random.default_rng(4)
+    f, b, c = 5, 9, 3
+    seg, attr, thr, nseg, real, _ = _binary_flat([9, 4, 7, 2, 9], b)
+    table = jnp.asarray(rng.integers(0, 1000, size=(f, b, k, c)).astype(np.int32))
+    cum = info.cumulative_level_table(table)
+    h_cum = np.asarray(info.binary_split_histograms(cum, attr, thr))
+    h_ein = np.asarray(info.split_segment_histograms(table, seg, attr, 2))
+    np.testing.assert_array_equal(h_cum[real], h_ein[real])
+
+
+@pytest.mark.parametrize("algo", dtree.ALGORITHMS)
+def test_cumsum_scores_bitwise_equal(algo):
+    """The cumsum fast path's SCORES must be bit-identical (not just
+    close) to the einsum path's, through the same jitted dispatch — the
+    property the byte-identical-tree contract between hist modes rests
+    on."""
+    rng = np.random.default_rng(5)
+    f, b, c = 5, 9, 2
+    seg, attr, thr, nseg, real, chunk = _binary_flat([9, 4, 7, 2, 9], b)
+    for k in (1, 3):
+        table = jnp.asarray(
+            rng.integers(0, 500, size=(f, b, k, c)).astype(np.int32))
+        s_ein, _ = dtree._device_score_all(
+            table, seg, attr, nseg, jnp.float32(0.0), None, algorithm=algo,
+            gmax=2, chunk=chunk, has_parent=False, binary=False)
+        s_cum, _ = dtree._device_score_all(
+            table, seg, attr, nseg, jnp.float32(0.0), thr, algorithm=algo,
+            gmax=2, chunk=chunk, has_parent=False, binary=True)
+        a1, a2 = np.asarray(s_ein)[real], np.asarray(s_cum)[real]
+        assert (a1.view(np.int32) == a2.view(np.int32)).all(), algo
+
+
+@pytest.mark.parametrize("use_kernel", [False, True])
+@pytest.mark.parametrize("frontier_case", ["full", "settled_sibling",
+                                           "single_node"])
+def test_subtract_table_matches_direct_contraction(use_kernel, frontier_case):
+    """Property: the sibling-subtraction assembly (direct slots for the
+    smaller children + parent-slice subtraction for each largest child)
+    reproduces the full direct contraction bit-for-bit — for multiway
+    splits, settled (non-frontier) siblings, single-node frontiers, and
+    through BOTH the einsum contraction and the interpret-mode Pallas
+    cross kernel."""
+    rng = np.random.default_rng(6)
+    n, f, b, c = 5000, 4, 6, 3
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    labels = rng.integers(-1, c + 1, size=n).astype(np.int32)  # some invalid
+    # previous level: 3 parents (local 0..2), some settled (-1) rows
+    node_prev = rng.integers(-1, 3, size=n).astype(np.int32)
+    # children: parent 0 → abs {10, 11} (binary on codes[:,0] >= 3);
+    # parent 1 → abs {12, 13, 14} (3-way on codes[:,1] mod 3); parent 2
+    # does not split (its rows keep a settled id)
+    node_child = np.full(n, -1, np.int32)
+    p0 = node_prev == 0
+    node_child[p0] = np.where(codes[p0, 0] >= 3, 11, 10)
+    p1 = node_prev == 1
+    node_child[p1] = 12 + (codes[p1, 1] % 3)
+    masses0 = [int((node_child == 10).sum()), int((node_child == 11).sum())]
+    masses1 = [int((node_child == g).sum()) for g in (12, 13, 14)]
+    split_records = [(0, [10, 11], np.asarray(masses0)),
+                     (1, [12, 13, 14], np.asarray(masses1))]
+    if frontier_case == "full":
+        new_frontier = [10, 11, 12, 13, 14]
+    elif frontier_case == "settled_sibling":
+        # drop one non-largest sibling of each parent from the frontier —
+        # the subtraction must still contract it as a direct slot
+        g0 = int(np.argmax(masses0))
+        g1 = int(np.argmax(masses1))
+        drop = {[10, 11][1 - g0], [12, 13, 14][(g1 + 1) % 3]}
+        new_frontier = [x for x in [10, 11, 12, 13, 14] if x not in drop]
+    else:
+        new_frontier = [[10, 11][int(np.argmax(masses0))]]   # derived alone
+    plan = dtree.DecisionTree._subtract_plan(split_records, new_frontier, 15)
+    remap_direct, dslot, pslot, sib_mat, kd = plan
+    k = len(new_frontier)
+    remap_f = np.full(15, -1, np.int32)
+    for i, nid in enumerate(new_frontier):
+        remap_f[nid] = i
+
+    def contract(local, width):
+        if use_kernel:
+            return dtree._level_table_cross(
+                jnp.asarray(codes.T), jnp.asarray(local), jnp.asarray(labels),
+                width, c, b, interpret=True)
+        return dtree.node_bin_class_counts(
+            jnp.asarray(codes), jnp.asarray(local), jnp.asarray(labels),
+            width, c, b)
+
+    prev_table = contract(node_prev, 3)
+    local_f = np.where(node_child >= 0, remap_f[np.maximum(node_child, 0)], -1)
+    oracle = np.asarray(contract(local_f, k))
+    local_d = np.where(node_child >= 0,
+                       remap_direct[np.maximum(node_child, 0)], -1)
+    direct = contract(local_d, max(kd, 1))
+    assembled = np.asarray(dtree._assemble_subtract_table(
+        direct, prev_table, jnp.asarray(dslot), jnp.asarray(pslot),
+        jnp.asarray(sib_mat)))
+    np.testing.assert_array_equal(assembled, oracle)
+
+
+def test_hist_modes_byte_identical_to_host_oracle(retarget):
+    """Acceptance gate: every tree.hist.mode grows trees byte-identical
+    to the selection='host' oracle across all 4 algorithms on the
+    binary-threshold candidate family (the cumsum/subtract fast paths),
+    plus exhaustive search under subtract (level tables only)."""
+    _, _, ds, is_cat = retarget
+    for algo in dtree.ALGORITHMS:
+        kw = dict(algorithm=algo, max_depth=3, split_search="binary",
+                  min_node_size=64)
+        oracle = dtree.DecisionTree(selection="host", **kw).fit(
+            ds, is_cat).to_string()
+        for mode in dtree.HIST_MODES:
+            m = dtree.DecisionTree(selection="device", hist_mode=mode,
+                                   **kw).fit(ds, is_cat)
+            assert m.to_string() == oracle, (algo, mode)
+    kw = dict(algorithm="entropy", max_depth=3, max_split=3,
+              max_candidates_per_attr=300)
+    oracle = dtree.DecisionTree(selection="host", **kw).fit(
+        ds, is_cat).to_string()
+    m = dtree.DecisionTree(selection="device", hist_mode="subtract",
+                           **kw).fit(ds, is_cat)
+    assert m.to_string() == oracle, "exhaustive + subtract"
+
+
+def test_predict_fn_padded_byte_identical_and_bucket_stable(retarget):
+    """predict_fn's pow-2 padded walker must (a) produce byte-identical
+    predictions to the unpadded form and (b) give equal shape signatures
+    for retrained trees within the same depth bucket, so a hot-swap
+    reuses the compiled program (the serving-side zero-swap-recompile
+    contract rides this)."""
+    _, _, ds, is_cat = retarget
+    m4 = dtree.DecisionTree(max_depth=4).fit(ds, is_cat)
+    m3 = dtree.DecisionTree(max_depth=3, seed=5).fit(ds, is_cat)
+    codes = jnp.asarray(ds.codes)
+    p_pad, d_pad = dtree.predict_fn(m4, pad_shapes=True)(codes)
+    p_raw, d_raw = dtree.predict_fn(m4, pad_shapes=False)(codes)
+    np.testing.assert_array_equal(np.asarray(p_pad), np.asarray(p_raw))
+    np.testing.assert_array_equal(np.asarray(d_pad), np.asarray(d_raw))
+    assert dtree.predict_shape_signature(m4) == \
+        dtree.predict_shape_signature(m3)
+    # bucketing keys on the CONFIGURED cap, not the grown depth: a
+    # retrain at the same cap that happens to grow shallower must stay
+    # in the same bucket (and survive a serde round trip)
+    m_shallow = dtree.DecisionTree(max_depth=4, min_node_size=4000).fit(
+        ds, is_cat)
+    assert m_shallow.max_depth < m4.max_depth
+    assert dtree.predict_shape_signature(m_shallow) == \
+        dtree.predict_shape_signature(m4)
+    rt = dtree.DecisionTreeModel.from_string(m4.to_string())
+    assert rt.depth_cap == 4
+    assert dtree.predict_shape_signature(rt) == \
+        dtree.predict_shape_signature(m4)
+    # same bucket ⇒ the module-level walker serves both without a fresh
+    # compile (shape-keyed jit cache)
+    if hasattr(dtree._tree_walk, "_cache_size"):
+        dtree.predict_fn(m4)(codes)
+        size = dtree._tree_walk._cache_size()
+        dtree.predict_fn(m3)(codes)
+        assert dtree._tree_walk._cache_size() == size
+
+
+def test_shape_signature_buckets_on_split_cap():
+    """Under a 5-way split cap, a retrain that happens to grow only
+    narrow splits must keep the predecessor's segment bucket (split_cap
+    rides the model like depth_cap — grown gmax alone would re-bucket
+    and recompile on hot-swap)."""
+    def mk(gmax_grown):
+        root = dtree.TreeNode(0, 0, np.array([50.0, 50.0]))
+        segs = np.zeros(6, np.int32)
+        segs[:gmax_grown] = np.arange(gmax_grown)
+        root.split = dtree.CandidateSplit(0, "categorical", segs,
+                                          gmax_grown, "k")
+        kids = [dtree.TreeNode(i + 1, 1, np.array([5.0, 5.0]))
+                for i in range(gmax_grown)]
+        root.children = [kid.node_id for kid in kids]
+        return dtree.DecisionTreeModel([root] + kids, ["N", "Y"], 6,
+                                       "entropy", depth_cap=4, split_cap=5)
+    wide, narrow = mk(5), mk(2)
+    assert dtree.predict_shape_signature(wide) == \
+        dtree.predict_shape_signature(narrow)
+    rt = dtree.DecisionTreeModel.from_string(wide.to_string())
+    assert rt.split_cap == 5
+    assert dtree.predict_shape_signature(rt) == \
+        dtree.predict_shape_signature(wide)
 
 
 def test_node_bin_class_counts_blocked_path(monkeypatch):
