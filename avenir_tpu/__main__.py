@@ -87,6 +87,12 @@ def main(argv: List[str]) -> int:
         except Exception:
             pass                       # cache is an optimization, never fatal
     counters = job.run(conf, positional[0], positional[1])
+    # journal the final counter snapshot under the job's name so a traced
+    # one-shot run is scrapeable post-hoc (`telemetry metrics <journal>`
+    # renders the journal's LAST snapshot) — no-op when tracing is off
+    from avenir_tpu.telemetry import spans as tel
+
+    tel.tracer().counters(job_name, counters)
     for group, vals in sorted(counters.as_dict().items()):
         print(group)
         for k, v in sorted(vals.items()):

@@ -122,6 +122,14 @@ class Job:
         with tracer.span(f"job.{self.name or type(self).__name__}",
                          attrs=attrs):
             self.execute(conf, input_path, output_path, counters)
+        # GraftProf: flush cumulative program wall totals at the job
+        # boundary — a one-shot CLI run exits without ever calling
+        # Tracer.disable, and totals below the periodic flush threshold
+        # would otherwise die with the process (no-op when profiling is
+        # off or nothing new was sampled)
+        from avenir_tpu.telemetry import profile as _profile
+
+        _profile.profiler().flush()
         return counters
 
     def execute(self, conf: JobConfig, input_path: str, output_path: str,
@@ -525,24 +533,40 @@ class Job:
         generalized compile-key diff so the ``Telemetry::recompiles``
         counter measures shape churn in BATCH streams exactly like the
         serving batcher measures it online (a steady stream recompiles
-        once at most, for the ragged tail chunk)."""
+        once at most, for the ragged tail chunk).
+
+        GraftProf (round 14): under ``profile.on`` each chunk's dispatch
+        shape is also a registered program — the span gains a
+        ``program=<id>`` attr, the consumer-side wall accumulates against
+        it, and device memory is sampled at the chunk boundary (the
+        monitor's key feed is the one compile-key source; the registry
+        rides it, so program count == primed + recompiled keys by
+        construction)."""
         import time as _time
 
+        from avenir_tpu.telemetry import profile as _profile
         from avenir_tpu.telemetry import spans as tel
 
         def gen():
             tracer = tel.tracer()
+            prof = _profile.profiler()
             monitor = tel.CompileKeyMonitor(counters, scope="stream",
                                             auto_prime=True)
             parent = tracer.current()
             for k, ds in enumerate(chunks):
-                monitor.observe([tel.CompileKeyMonitor.shape_key(
-                    ds.codes, ds.labels, ds.cont)])
+                key = tel.CompileKeyMonitor.shape_key(
+                    ds.codes, ds.labels, ds.cont)
+                monitor.observe([key])
+                attrs = {"chunk": k, "rows": ds.num_rows}
+                if prof.enabled:
+                    attrs["program"] = _profile.program_id("stream", key)
                 t0 = _time.perf_counter()
                 yield ds
-                tracer.emit_span("chunk", _time.perf_counter() - t0,
-                                 parent=parent,
-                                 attrs={"chunk": k, "rows": ds.num_rows})
+                dur_s = _time.perf_counter() - t0
+                if prof.enabled:
+                    prof.sample(key, "stream", dur_s)
+                    prof.sample_device_memory("chunk")
+                tracer.emit_span("chunk", dur_s, parent=parent, attrs=attrs)
 
         return gen()
 

@@ -1066,13 +1066,38 @@ class DecisionTree:
                 # once-per-level descriptor transfer that replaced the
                 # full-table fetch (the r05 RTT wall this rule encodes)
                 top_k = min(max(self.top_n, 1), flat.seg_tab_dev.shape[0])
+                allow_dev = jnp.asarray(flat.allow_vector(attrs_lv))
+                thr_dev = flat.thr_dev if use_cum else None
+                statics = dict(algorithm=self.algorithm, gmax=flat.gmax,
+                               top_k=top_k, chunk=flat.chunk,
+                               binary=use_cum)
+                from avenir_tpu.telemetry import profile as _profile
+
+                prof = _profile.profiler()
+                pkey = None
+                if prof.enabled:
+                    # GraftProf: the level-selection program, keyed on
+                    # the dispatch shapes + statics; the jitted callable
+                    # itself is the AOT cost probe (one extra compile
+                    # per distinct key — the opt-in price of the table)
+                    from avenir_tpu.telemetry.spans import CompileKeyMonitor
+                    pkey = CompileKeyMonitor.shape_key(
+                        table_dev, flat.seg_tab_dev, thr_dev) + (
+                        tuple(sorted(statics.items())),)
+                    prof.observe(
+                        pkey, site="tree.level",
+                        lowerable=_device_select_splits,
+                        args=(table_dev, flat.seg_tab_dev, flat.attr_dev,
+                              flat.nseg_dev, allow_dev, thr_dev),
+                        kwargs=statics)
+                    t_disp = time.perf_counter()
                 # graftlint: disable=GL005
                 vals, idx, whist = jax.device_get(_device_select_splits(
                     table_dev, flat.seg_tab_dev, flat.attr_dev,
-                    flat.nseg_dev, jnp.asarray(flat.allow_vector(attrs_lv)),
-                    flat.thr_dev if use_cum else None,
-                    algorithm=self.algorithm, gmax=flat.gmax, top_k=top_k,
-                    chunk=flat.chunk, binary=use_cum))
+                    flat.nseg_dev, allow_dev, thr_dev, **statics))
+                if pkey is not None:
+                    prof.sample(pkey, "tree.level",
+                                time.perf_counter() - t_disp)
                 for ki in range(k):
                     for p in range(top_k):
                         s = float(vals[ki, p])

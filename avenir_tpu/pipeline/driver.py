@@ -13,6 +13,7 @@ process.
 
 from __future__ import annotations
 
+import contextlib
 import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
@@ -135,6 +136,23 @@ class Pipeline:
             return group, confs, True
         return [first], confs[:1], False
 
+    def _xla_trace(self, name: str, tracer):
+        """Per-stage XProf/XLA capture (round 14, off by default): with
+        ``trace.xla.dir`` set, each executed stage (or fused group) runs
+        under ``utils/profiling.trace`` into its own subdirectory —
+        ``<trace.xla.dir>/<stage name>`` — viewable in TensorBoard/XProf,
+        and the capture path is journaled (``xla.trace``) so the run's
+        timeline names its own device traces.  Unset: a null context, no
+        jax.profiler import on the path."""
+        xla_dir = self.conf.get("trace.xla.dir")
+        if not xla_dir:
+            return contextlib.nullcontext()
+        from avenir_tpu.utils import profiling
+
+        path = os.path.join(xla_dir, name)
+        tracer.event("xla.trace", stage=name, dir=path)
+        return profiling.trace(path)
+
     def rollup(self) -> Counters:
         """Run-level counter rollup: the SUM of every stage's counters
         (``merge_add`` — overwrite-merge would keep only the last stage's
@@ -181,6 +199,11 @@ class Pipeline:
             ShardSpec.from_conf(self.conf)
             self._run_stages(todo, resume, tracer)
             tracer.counters("pipeline", self.rollup())
+        # fused-scan samples never pass through Job.run — flush them here
+        # so the run journal's program totals are complete at pipeline end
+        from avenir_tpu.telemetry import profile as _profile
+
+        _profile.profiler().flush()
         return self.counters
 
     def _run_stages(self, todo: List[Stage], resume: bool, tracer) -> None:
@@ -211,7 +234,8 @@ class Pipeline:
                 with tracer.span("scan.fused",
                                  attrs={"stages": [s.name for s in group],
                                         "input": self.path(group[0].input)}
-                                 ) as sp:
+                                 ) as sp, \
+                        self._xla_trace(group[0].name, tracer):
                     fused = scan.run_fused_stages(
                         [(s.name, s.job, self.path(s.input),
                           self.path(s.output), conf)
@@ -235,7 +259,8 @@ class Pipeline:
                 # stages, streaming); this stage runs its normal path —
                 # say so in the trace instead of implying parallelism
                 attrs["sharded"] = stage.job == "StreamAnalytics"
-            with tracer.span(f"stage.{stage.name}", attrs=attrs):
+            with tracer.span(f"stage.{stage.name}", attrs=attrs), \
+                    self._xla_trace(stage.name, tracer):
                 self.counters[stage.name] = stage.run(
                     conf, self.path(stage.input), out)
                 tracer.counters(stage.name, self.counters[stage.name])

@@ -39,6 +39,7 @@ artifact with a compatible schema into one SharedScan stage
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -340,6 +341,23 @@ class ChunkFolder:
             self._collective_bytes = gbytes + 4 * c * (
                 2 + 2 * meta.num_cont if self.needs_moments else 1)
 
+    def cost_probe(self, ds: EncodedDataset):
+        """(lowerable, args) for this folder's per-chunk device program —
+        the GraftProf AOT cost hook.  Only the single-dispatch kernel
+        routings are probeable (the program IS the chunk pass); the
+        einsum fallback and the shard_map path dispatch several programs
+        per chunk, so they register shapes-only rather than publishing a
+        misleading single-program cost."""
+        from avenir_tpu.ops import pallas_hist
+
+        if self.step == "kernel":
+            if self.needs_moments:
+                return (pallas_hist.gram_moments,
+                        (ds.codes, ds.labels, ds.cont, self.b, self.c))
+            return (pallas_hist.cooc_counts,
+                    (ds.codes, ds.labels, self.b, self.c))
+        return None
+
     def fold(self, ds: EncodedDataset, acc: agg.Accumulator) -> None:
         """One chunk's device pass + 64-bit host accumulation into ``acc``."""
         from avenir_tpu.ops import pallas_hist
@@ -515,9 +533,11 @@ class SharedScan:
         folder = ChunkFolder(self._consumers, meta, mesh=self.mesh,
                              pair_chunk=self.pair_chunk, shard=self.shard,
                              counters=self.counters)
+        from avenir_tpu.telemetry import profile as _profile
         from avenir_tpu.telemetry import spans as tel
 
         tracer = tel.tracer()
+        prof = _profile.profiler()
         acc = agg.Accumulator()
         rows = 0
         self.chunks_seen = 0
@@ -532,15 +552,33 @@ class SharedScan:
                 # padded; valid_rows is its true count — never count pad
                 true_rows = (ds.valid_rows if ds.valid_rows is not None
                              else ds.num_rows)
-                with tracer.span("scan.chunk",
-                                 attrs={"chunk": self.chunks_seen,
-                                        "rows": true_rows}):
+                chunk_attrs = {"chunk": self.chunks_seen, "rows": true_rows}
+                pkey = None
+                if prof.enabled:
+                    # GraftProf: the fold program — registered with AOT
+                    # cost where the routing is single-dispatch, sampled
+                    # per chunk so the profile table knows this seam
+                    pkey = tel.CompileKeyMonitor.shape_key(
+                        ds.codes, ds.labels, ds.cont) + (
+                        folder.step or "moments",)
+                    probe = folder.cost_probe(ds)
+                    chunk_attrs["program"] = prof.observe(
+                        pkey, site="scan.chunk",
+                        lowerable=probe[0] if probe else None,
+                        args=probe[1] if probe else ())
+                with tracer.span("scan.chunk", attrs=chunk_attrs):
                     # host accumulation inside fetches every device result,
                     # so the chunk span's close is naturally synced.
                     # Recompile accounting lives with the chunk SOURCE
                     # (jobs' _chunk_telemetry) — a second monitor here
                     # would double-count the same stream
+                    t0 = time.perf_counter()
                     folder.fold(ds, acc)
+                    if pkey is not None:
+                        prof.sample(pkey, "scan.chunk",
+                                    time.perf_counter() - t0)
+                if prof.enabled:
+                    prof.sample_device_memory("scan")
                 rows += true_rows
                 self.chunks_seen += 1
             scan_span.set("chunks", self.chunks_seen)

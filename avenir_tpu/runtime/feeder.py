@@ -97,15 +97,29 @@ class DeviceFeeder:
         marker would point at the wrong seam.  Pull and stage run
         sequentially on the one worker thread, so the shared time box is
         race-free.  With tracing off this returns the inputs untouched —
-        no wrapper frame on the hot path."""
+        no wrapper frame on the hot path.
+
+        GraftProf (round 14): under ``profile.on`` the staged chunk is
+        also a device-memory sampling boundary (the upload is where HBM
+        grows) — wrapped even when tracing is off, so a profile-only run
+        still gauges staging."""
+        from avenir_tpu.telemetry import profile as _profile
         from avenir_tpu.telemetry import spans as tel
 
         tracer = tel.tracer()
-        if not tracer.enabled:
+        prof = _profile.profiler()
+        if not tracer.enabled and not prof.enabled:
             return source, stage
-        parent = tracer.current()
         inner = stage or (lambda item, _d=device:
                           DeviceFeeder._default_stage(item, _d))
+        if not tracer.enabled:
+            def profiled_stage(item):
+                out = inner(item)
+                prof.sample_device_memory("feeder")
+                return out
+
+            return source, profiled_stage
+        parent = tracer.current()
         box = {"t0": None, "chunk": itertools.count()}
 
         def timed_source():
@@ -127,6 +141,8 @@ class DeviceFeeder:
             tracer.emit_span("feeder.stage", time.perf_counter() - t0,
                              parent=parent,
                              attrs={"chunk": next(box["chunk"])})
+            if prof.enabled:
+                prof.sample_device_memory("feeder")
             return out
 
         return timed_source(), traced_stage

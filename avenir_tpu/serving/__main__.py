@@ -43,6 +43,11 @@ def main(argv: List[str]) -> int:
     from avenir_tpu.serving.registry import ModelRegistry
 
     conf = JobConfig.from_file(args.conf)
+    # wire GraftTrace/GraftProf from the same properties file the models
+    # load from (trace.on / profile.on — both default off)
+    from avenir_tpu.telemetry import spans as tel
+
+    tel.configure(conf)
     registry = ModelRegistry.from_conf(conf)
     batcher = BucketedMicrobatcher.from_conf(registry, conf)
     port = (args.http_port if args.http_port is not None

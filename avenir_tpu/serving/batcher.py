@@ -43,6 +43,7 @@ from avenir_tpu.serving.errors import (
     ShedError,
 )
 from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.telemetry import profile as prof_mod
 from avenir_tpu.telemetry import spans as tel
 from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
 
@@ -171,6 +172,10 @@ class BucketedMicrobatcher:
         self.counters.increment(f"Serving.{model}", "swaps")
         tel.tracer().event("model.swap", model=model, version=version,
                            family=entry.family, warmed=bool(warm))
+        # swap boundary: the outgoing entry's device buffers should be
+        # collectable once in-flight batches drain — a leak across
+        # repeated hot-swaps shows up in this gauge before it OOMs
+        prof_mod.profiler().sample_device_memory("swap")
         return version
 
     # -- submission (any thread) ---------------------------------------------
@@ -259,7 +264,9 @@ class BucketedMicrobatcher:
         entry = self.registry.get(model)
         bucket = self._bucket_for(len(live))
         try:
+            t0 = time.monotonic()
             outs = entry.score_lines([r.line for r in live], bucket)
+            dispatch_s = time.monotonic() - t0
         except Exception as exc:
             # one bad row must not poison its coalesced batch neighbors:
             # re-score each request alone (smallest bucket — warmed, so no
@@ -272,7 +279,8 @@ class BucketedMicrobatcher:
                    else RequestError(f"{type(exc).__name__}: {exc}"))
             live[0].finish(error=err)
             return
-        self._finish_scored(entry, group, model, live, outs, bucket)
+        self._finish_scored(entry, group, model, live, outs, bucket,
+                            dispatch_s)
 
     def _dispatch_isolated(self, entry, group: str,
                            reqs: List[PendingRequest]) -> None:
@@ -293,21 +301,36 @@ class BucketedMicrobatcher:
 
     def _finish_scored(self, entry, group: str, model: str,
                        live: List[PendingRequest], outs: List[str],
-                       bucket: int) -> None:
+                       bucket: int,
+                       dispatch_s: Optional[float] = None) -> None:
         # a shape outside the warmed set means this batch paid a compile
         # on the hot path — the invariant violation the counter exposes
+        # (the monitor's key feed also registers each key as a GraftProf
+        # program under site=<model>)
         self._monitors[model].observe(entry.compile_keys)
         done = time.monotonic()
         tracer = tel.tracer()
+        prof = prof_mod.profiler()
+        pid = None
+        if prof.enabled:
+            # the program this batch dispatched: the entry's compile key
+            # for this bucket (every entry keys on (bucket, ...))
+            pkey = next((k for k in entry.compile_keys
+                         if k and k[0] == bucket), (bucket,))
+            pid = prof_mod.program_id(model, pkey)
+            if dispatch_s is not None:
+                prof.sample(pkey, model, dispatch_s)
         tracker = self.latency[model]
         for req, out in zip(live, outs):
             req.finish(result=out)
             wait_s = done - req.enqueued
             tracker.record(wait_s)
             if tracer.enabled:
+                attrs = {"model": model, "bucket": bucket}
+                if pid is not None:
+                    attrs["program"] = pid
                 tracer.emit_span("serve.request", wait_s,
-                                 parent=req.trace_ctx,
-                                 attrs={"model": model, "bucket": bucket})
+                                 parent=req.trace_ctx, attrs=attrs)
         self.counters.increment(group, "requests", len(live))
         self.counters.increment(group, "batches")
         self.counters.increment(group, f"bucket.{bucket}")

@@ -82,7 +82,10 @@ class ScoreHTTPServer:
                 if self.path == "/metrics":
                     # Prometheus text exposition of the same counters the
                     # journal snapshots and /stats reports as JSON —
-                    # scrape-ready (telemetry/export.py)
+                    # scrape-ready (telemetry/export.py); under
+                    # profile.on the GraftProf device-memory gauges
+                    # (avenir_device_bytes) ride the same page
+                    from avenir_tpu.telemetry import profile as _profile
                     from avenir_tpu.telemetry.export import prometheus_text
 
                     gauges = {f"serve.queue.{name}": float(depth)
@@ -91,9 +94,11 @@ class ScoreHTTPServer:
                     gauges["uptime.sec"] = time.monotonic() - outer.started
                     self._send_text(
                         200,
-                        prometheus_text(counters=outer.batcher.counters,
-                                        latency=outer.batcher.latency,
-                                        gauges=gauges),
+                        prometheus_text(
+                            counters=outer.batcher.counters,
+                            latency=outer.batcher.latency,
+                            gauges=gauges,
+                            device_bytes=_profile.profiler().gauges()),
                         "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path == "/healthz":
                     self._send(200, {

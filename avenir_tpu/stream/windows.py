@@ -46,6 +46,7 @@ not assumed (``benchmarks/streaming_soak.py``).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -261,12 +262,26 @@ class WindowedScan:
         lines = self._pane_buf
         self._pane_buf = []
         acc = agg.Accumulator()
+        from avenir_tpu.telemetry import profile as _profile
+
+        prof = _profile.profiler()
         if lines:
             ds = self._encode(lines)
             ds = self._pad(ds)
-            self._monitor.observe([tel.CompileKeyMonitor.shape_key(
-                ds.codes, ds.labels, ds.cont)])
+            key = tel.CompileKeyMonitor.shape_key(
+                ds.codes, ds.labels, ds.cont)
+            # the monitor's key feed doubles as the GraftProf program
+            # registration (site = this monitor's scope)
+            self._monitor.observe([key])
+            t0 = time.perf_counter()
             self.folder.fold(ds, acc)
+            if prof.enabled:
+                prof.sample(key, self._monitor.scope,
+                            time.perf_counter() - t0)
+        if prof.enabled:
+            # pane boundary: the seam where an HBM leak across stream
+            # windows (pane ring growth, model hot-swap debris) shows up
+            prof.sample_device_memory("pane")
         self._ring.append({"pane": self.panes_closed, "rows": len(lines),
                            "state": acc.state(),
                            "lines": list(lines) if self.retain_rows else None})

@@ -9,12 +9,25 @@ profiling/counters machinery):
 - ``journal``: the append-only JSONL run journal (single-writer,
   rotation-bounded, torn-tail tolerant) every span and event lands in;
 - ``export``: Prometheus text rendering of Counters + latency trackers +
-  gauges, served from the scoring plane's ``/metrics`` route.
+  gauges + device-memory bytes, served from the scoring plane's
+  ``/metrics`` route;
+- ``profile``: GraftProf (round 14) — the compiled-program registry
+  (AOT cost analysis per distinct compile key, per-program wall totals)
+  and device-memory gauges, free until ``profile.on``;
+- ``sentinel``: the perf-regression gate over bench artifacts
+  (``telemetry regress``; bench.py embeds its verdict in-process).
 
-``python -m avenir_tpu.telemetry <journal>`` renders a run's span tree.
+``python -m avenir_tpu.telemetry <journal>`` renders a run's span tree;
+``profile`` / ``metrics`` / ``regress`` subcommands render the roofline
+table, the post-hoc Prometheus snapshot, and the regression verdict.
 """
 
 from avenir_tpu.telemetry.journal import Journal, latest_journal, read_events
+from avenir_tpu.telemetry.profile import (
+    CompiledProgramRegistry,
+    Profiler,
+    profiler,
+)
 from avenir_tpu.telemetry.spans import (
     NOOP_SPAN,
     CompileKeyMonitor,
@@ -26,12 +39,15 @@ from avenir_tpu.telemetry.spans import (
 
 __all__ = [
     "CompileKeyMonitor",
+    "CompiledProgramRegistry",
     "Journal",
     "NOOP_SPAN",
+    "Profiler",
     "Span",
     "Tracer",
     "configure",
     "latest_journal",
+    "profiler",
     "read_events",
     "tracer",
 ]

@@ -1,12 +1,27 @@
-"""GraftTrace journal viewer — ``python -m avenir_tpu.telemetry <journal>``.
+"""GraftTrace/GraftProf journal CLI — ``python -m avenir_tpu.telemetry``.
 
-Renders a run journal (``telemetry/journal.py`` JSONL) as a per-trace span
-tree: one line per span with its wall duration, the slowest root→leaf path
-highlighted (``◀`` — the first place to look in a slow run), still-open
-spans flagged (``OPEN`` — the first place to look in a *wedged* run),
-counter deltas between successive snapshots of the same scope, and a
-one-line tally of the free events (checkpoints, recompiles, gauges,
-canaries).  Stdlib-only — usable on a machine with no JAX installed.
+Subcommands (the bare ``<journal>`` form keeps rendering the span tree):
+
+- ``<journal>`` / ``tree <journal>`` — per-trace span tree: one line per
+  span with its wall duration, the slowest root→leaf path highlighted
+  (``◀`` — the first place to look in a slow run), still-open spans
+  flagged (``OPEN`` — the first place to look in a *wedged* run), counter
+  deltas between successive snapshots of the same scope, and a one-line
+  tally of the free events (checkpoints, recompiles, gauges, canaries).
+- ``profile <journal>`` — the GraftProf roofline table: one row per
+  compiled program (``program.compiled`` + cumulative ``program.profile``
+  events) with dispatch counts, wall time, achieved FLOP/s and an MFU
+  column against the canary-derived peak (the journal's best 4096³ bf16
+  matmul canary; ``--peak-tflops`` overrides).  FLOPs are XLA cost-model
+  estimates — roofline/regression material, not hardware counters.
+- ``metrics <journal>`` — the journal's LAST counter/gauge/device-memory
+  snapshot as Prometheus text, so batch-only and crashed runs are
+  scrapeable post-hoc (``/metrics`` only exists while the serving
+  frontend runs).
+- ``regress <bench.json...> --baseline <artifact>`` — the perf-regression
+  sentinel (``telemetry/sentinel.py``); exits 0/1/3.
+
+Stdlib-only — usable on a machine with no JAX installed.
 """
 
 from __future__ import annotations
@@ -141,22 +156,175 @@ def render(events: List[dict], trace_filter: Optional[str] = None
     return out
 
 
+# ---------------------------------------------------------------------------
+# GraftProf renderers (round 14)
+# ---------------------------------------------------------------------------
+
+# one 4096³ bf16 matmul canary call = 2·4096³ FLOPs (utils/rig_canary.py)
+_CANARY_FLOPS_PER_CALL = 2.0 * 4096 ** 3
+
+
+def canary_peak_flops(events: List[dict]) -> Optional[float]:
+    """Peak FLOP/s derived from the journal's best (lowest-ms) matmul
+    canary reading — the denominator of the profile table's MFU column.
+    None when the journal carries no positive canary reading."""
+    best = None
+    for event in events:
+        if event.get("ev") != "canary":
+            continue
+        ms = event.get("ms")
+        if isinstance(ms, (int, float)) and ms > 0:
+            best = ms if best is None else min(best, ms)
+    if best is None:
+        return None
+    return _CANARY_FLOPS_PER_CALL / (best / 1e3)
+
+
+def render_profile(events: List[dict],
+                   peak_flops: Optional[float] = None) -> List[str]:
+    """The per-program roofline table from ``program.compiled`` (cost
+    fields) + ``program.profile`` (cumulative dispatch/wall totals — the
+    LAST event per program wins) events."""
+    programs: Dict[str, dict] = {}
+    for event in events:
+        ev = event.get("ev")
+        if ev == "program.compiled":
+            rec = programs.setdefault(event.get("key", "?"), {})
+            rec.update(site=event.get("site", "?"),
+                       flops=event.get("flops"),
+                       bytes_accessed=event.get("bytes_accessed"),
+                       output_bytes=event.get("output_bytes"),
+                       temp_bytes=event.get("temp_bytes"),
+                       source=event.get("source", "shapes"),
+                       shapes=event.get("shapes", ""))
+        elif ev == "program.profile":
+            rec = programs.setdefault(event.get("key", "?"), {})
+            rec["site"] = event.get("site", rec.get("site", "?"))
+            rec["dispatches"] = event.get("dispatches", 0)
+            rec["wall_ms"] = event.get("wall_ms", 0.0)
+    if not programs:
+        return ["journal carries no program.compiled/profile events "
+                "(profile.on unset, or the run predates GraftProf)"]
+    peak_src = "--peak-tflops override"
+    if peak_flops is None:
+        peak_flops = canary_peak_flops(events)
+        peak_src = "canary-derived; best matmul canary in this journal"
+    out = [f"{'program':<12} {'site':<14} {'disp':>6} {'wall ms':>10} "
+           f"{'ms/disp':>8} {'GFLOP/s':>9} {'MFU%':>6} {'GB/s':>7}  cost"]
+    ordered = sorted(programs.items(),
+                     key=lambda kv: -(kv[1].get("wall_ms") or 0.0))
+    for key, rec in ordered:
+        n = rec.get("dispatches", 0)
+        wall_ms = rec.get("wall_ms") or 0.0
+        flops = rec.get("flops")
+        gflops = mfu = gbps = "-"
+        if n and wall_ms > 0 and isinstance(flops, (int, float)):
+            achieved = flops * n / (wall_ms / 1e3)
+            gflops = f"{achieved / 1e9:.1f}"
+            if peak_flops:
+                mfu = f"{100.0 * achieved / peak_flops:.2f}"
+        ba = rec.get("bytes_accessed")
+        if n and wall_ms > 0 and isinstance(ba, (int, float)):
+            gbps = f"{ba * n / (wall_ms / 1e3) / 1e9:.2f}"
+        out.append(f"{key:<12} {rec.get('site', '?'):<14} {n:>6} "
+                   f"{wall_ms:>10.1f} "
+                   f"{(wall_ms / n if n else 0.0):>8.2f} {gflops:>9} "
+                   f"{mfu:>6} {gbps:>7}  {rec.get('source', 'shapes')}")
+    if peak_flops:
+        out.append(f"peak: {peak_flops / 1e12:.2f} TFLOP/s ({peak_src})")
+    else:
+        out.append("peak: unknown — no matmul canary event in this journal "
+                   "(pass --peak-tflops); MFU column empty")
+    out.append("flops/bytes are XLA cost-model ESTIMATES captured at "
+               "compile time, not hardware counters")
+    return out
+
+
+class _Groups:
+    """Duck-typed Counters stand-in (``as_dict`` only) so the stdlib CLI
+    can reuse export.render_counters without importing numpy."""
+
+    def __init__(self, groups: dict):
+        self._groups = groups
+
+    def as_dict(self) -> dict:
+        return self._groups
+
+
+def render_metrics(events: List[dict]) -> str:
+    """The journal's LAST counter snapshot, gauge readings and
+    device-memory samples as Prometheus text — the post-hoc ``/metrics``
+    for batch-only and crashed runs."""
+    from avenir_tpu.telemetry.export import prometheus_text
+
+    last_counters: Optional[dict] = None
+    scope = None
+    gauges: Dict[str, float] = {}
+    device_bytes: Dict[tuple, float] = {}
+    for event in events:
+        ev = event.get("ev")
+        if ev == "counters":
+            last_counters = event.get("groups", {})
+            scope = event.get("scope")
+        elif ev == "gauge":
+            gauges[str(event.get("name", "?"))] = float(
+                event.get("value", 0.0))
+        elif ev == "device.memory":
+            dev = str(event.get("device", "?"))
+            device_bytes[(dev, "bytes_in_use")] = float(
+                event.get("bytes_in_use", 0))
+            device_bytes[(dev, "peak_bytes")] = float(
+                event.get("peak_bytes", 0))
+    if last_counters is None and not gauges and not device_bytes:
+        return ("# journal carries no counters/gauge/device.memory "
+                "snapshots to render\n")
+    head = f"# last counter snapshot scope: {scope}\n" if scope else ""
+    return head + prometheus_text(
+        counters=_Groups(last_counters) if last_counters is not None
+        else None,
+        gauges=gauges or None, device_bytes=device_bytes or None)
+
+
 def main(argv: List[str]) -> int:
+    # subcommand dispatch with the legacy bare-journal form preserved
+    commands = ("tree", "profile", "metrics", "regress")
+    if argv and argv[0] in commands:
+        cmd, rest = argv[0], argv[1:]
+    else:
+        cmd, rest = "tree", list(argv)
+    if cmd == "regress":
+        from avenir_tpu.telemetry.sentinel import cli as regress_cli
+
+        return regress_cli(rest)
+
     ap = argparse.ArgumentParser(
-        prog="python -m avenir_tpu.telemetry",
-        description="Render a GraftTrace run journal as a span tree")
+        prog=f"python -m avenir_tpu.telemetry {cmd}".rstrip(),
+        description="Render a GraftTrace/GraftProf run journal")
     ap.add_argument("journal", help="run-*.jsonl journal file")
-    ap.add_argument("--trace", default=None,
-                    help="render only this trace id")
-    ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="dump the decoded events as a JSON array instead")
-    args = ap.parse_args(argv)
+    if cmd == "tree":
+        ap.add_argument("--trace", default=None,
+                        help="render only this trace id")
+        ap.add_argument("--json", action="store_true", dest="as_json",
+                        help="dump the decoded events as a JSON array")
+    elif cmd == "profile":
+        ap.add_argument("--peak-tflops", type=float, default=None,
+                        help="override the canary-derived peak (TFLOP/s)")
+    args = ap.parse_args(rest)
     try:
         events = read_events(args.journal)
     except OSError as exc:
         print(f"cannot read journal: {exc}", file=sys.stderr)
         return 2
     try:
+        if cmd == "profile":
+            peak = (args.peak_tflops * 1e12
+                    if args.peak_tflops is not None else None)
+            for line in render_profile(events, peak_flops=peak):
+                print(line)
+            return 0
+        if cmd == "metrics":
+            print(render_metrics(events), end="")
+            return 0
         if args.as_json:
             print(json.dumps(events))
             return 0

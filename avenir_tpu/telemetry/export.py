@@ -63,9 +63,24 @@ def render_gauges(gauges: Mapping[str, float], lines: List[str]) -> None:
             f'avenir_gauge{{name="{_escape(name)}"}} {gauges[name]:g}')
 
 
+def render_device_bytes(device_bytes: Mapping, lines: List[str]) -> None:
+    """GraftProf device-memory gauges: ``{(device, kind): bytes}`` from
+    :meth:`telemetry.profile.Profiler.gauges` — ``kind`` is
+    ``bytes_in_use`` / ``peak_bytes`` as ``device.memory_stats()``
+    reports them."""
+    lines.append("# HELP avenir_device_bytes Device memory "
+                 "(device.memory_stats) sampled at dispatch boundaries.")
+    lines.append("# TYPE avenir_device_bytes gauge")
+    for device, kind in sorted(device_bytes):
+        lines.append(
+            f'avenir_device_bytes{{device="{_escape(device)}",'
+            f'kind="{_escape(kind)}"}} {device_bytes[(device, kind)]:g}')
+
+
 def prometheus_text(counters=None,
                     latency: Optional[Mapping[str, object]] = None,
-                    gauges: Optional[Mapping[str, float]] = None) -> str:
+                    gauges: Optional[Mapping[str, float]] = None,
+                    device_bytes: Optional[Mapping] = None) -> str:
     """The full exposition document; any section may be omitted."""
     lines: List[str] = []
     if counters is not None:
@@ -74,4 +89,6 @@ def prometheus_text(counters=None,
         render_latency(latency, lines)
     if gauges:
         render_gauges(gauges, lines)
+    if device_bytes:
+        render_device_bytes(device_bytes, lines)
     return "\n".join(lines) + "\n"

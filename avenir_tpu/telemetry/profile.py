@@ -1,0 +1,308 @@
+"""GraftProf — the device-cost profiling plane (round 14).
+
+GraftTrace (round 10) answers *where wall-time went*; this module answers
+*what the device did for it*.  Three pieces, all free until ``profile.on``:
+
+- :class:`CompiledProgramRegistry` — the process-wide compiled-program
+  table.  Every dispatch seam that already feeds a
+  :class:`~avenir_tpu.telemetry.spans.CompileKeyMonitor` (batch chunk
+  streams, stream panes, the serving batcher) registers its compile keys
+  here too; on each *new* ``(site, key)`` the registry captures the
+  program's JAX AOT cost analysis — FLOPs estimate, bytes accessed,
+  output/temp HBM bytes via ``lowered.compile().cost_analysis()`` /
+  ``.memory_analysis()`` — and journals one golden-schema'd
+  ``program.compiled`` event.  The capture is guarded end to end: a
+  backend without cost analysis (or a seam that cannot hand over a
+  lowerable) degrades to a shapes-only record, never raises.  Per-dispatch
+  wall samples accumulate per program and flush to the journal as
+  cumulative ``program.profile`` events (every
+  ``_FLUSH_EVERY`` samples and at ``Tracer.disable``), so
+  ``python -m avenir_tpu.telemetry profile <journal>`` can render a
+  roofline-style table — dispatch counts, achieved FLOP/s, and an MFU
+  column against the canary-derived peak — without a per-dispatch journal
+  line.
+- **Device memory gauges** — :meth:`Profiler.sample_device_memory` reads
+  ``device.memory_stats()`` per local device at chunk/pane/swap/staging
+  boundaries (a no-op where the backend reports nothing, e.g. this
+  container's CPU transport), journals ``device.memory`` events and feeds
+  the ``avenir_device_bytes{device=...,kind=...}`` gauges the serving
+  ``/metrics`` route exposes — an HBM leak across stream windows or model
+  hot-swaps becomes visible before it OOMs.
+- ``configure(conf)`` — wired through ``telemetry.spans.configure`` so
+  every entry point that configures tracing (driver, jobs, serving CLI)
+  also configures profiling from the same conf.
+
+Cost-capture honesty notes:
+
+- flops/bytes are the XLA **cost model's estimates** for the compiled
+  program, not hardware counters — good for rooflines and regressions,
+  not for billing (docs/observability.md spells out the caveats).
+- the AOT capture lowers+compiles the program once per distinct key; that
+  duplicate compile is the price of the cost tables and is why profiling
+  is opt-in (``profile.on``), never ambient.
+- program identity is ``(site, compile key)``: two seams dispatching the
+  same shapes are different programs, and the serving batcher's
+  per-model keys never collide across models.
+
+Stdlib + in-package imports only at module scope — JAX is imported
+lazily inside the capture paths, so the journal CLI stays runnable on a
+machine with no JAX installed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from avenir_tpu.telemetry import spans as tel
+
+_FLUSH_EVERY = 64          # journal a cumulative program.profile this often
+
+
+def program_id(site: str, key) -> str:
+    """Stable short id for a ``(site, compile key)`` program — what span
+    ``program=`` attrs and journal events carry instead of the raw
+    (arbitrarily long) shape tuple."""
+    digest = hashlib.sha1(f"{site}|{key!r}".encode()).hexdigest()[:10]
+    return f"p{digest}"
+
+
+def aot_cost(lowerable, args: Tuple = (), kwargs: Optional[dict] = None
+             ) -> Optional[Dict[str, Optional[float]]]:
+    """JAX AOT cost/memory analysis for ``lowerable(*args, **kwargs)``.
+
+    ``lowerable`` is a jitted callable (anything with ``.lower``).  Every
+    step is guarded: a backend whose compiled executable exposes no
+    ``cost_analysis``/``memory_analysis`` (or a lowerable that refuses the
+    given operands) returns None — the registry then records a shapes-only
+    program, never an exception."""
+    if lowerable is None or not hasattr(lowerable, "lower"):
+        return None
+    try:
+        compiled = lowerable.lower(*args, **(kwargs or {})).compile()
+    except Exception:
+        return None
+    out: Dict[str, Optional[float]] = {
+        "flops": None, "bytes_accessed": None,
+        "output_bytes": None, "temp_bytes": None,
+    }
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict):
+            if ca.get("flops") is not None:
+                out["flops"] = float(ca["flops"])
+            if ca.get("bytes accessed") is not None:
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            out["output_bytes"] = float(
+                getattr(ma, "output_size_in_bytes", 0) or 0)
+            out["temp_bytes"] = float(
+                getattr(ma, "temp_size_in_bytes", 0) or 0)
+    except Exception:
+        pass
+    if all(v is None for v in out.values()):
+        return None
+    return out
+
+
+class Profiler:
+    """Process-wide program registry + device-memory gauges.
+
+    Disabled (one attribute check at every seam) until :meth:`enable`;
+    ``configure(conf)`` wires it from ``profile.*`` keys.  All mutation is
+    lock-guarded: the serving dispatcher, stream pane folds and batch
+    chunk loops register and sample concurrently."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        # (site, key) → program record; insertion order = discovery order
+        self._programs: Dict[Tuple[str, Any], dict] = {}
+        self._gauges: Dict[Tuple[str, str], float] = {}
+        self._mem_every = 1
+        self._mem_calls: Dict[str, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def enable(self, memory_sample: int = 1) -> "Profiler":
+        with self._lock:
+            self.enabled = True
+            self._mem_every = max(int(memory_sample), 0)
+        return self
+
+    def disable(self) -> None:
+        """Drop all registered state (run teardown, tests).  Does NOT
+        flush — ``Tracer.disable`` flushes first, then calls this."""
+        with self._lock:
+            self.enabled = False
+            self._programs.clear()
+            self._gauges.clear()
+            self._mem_calls.clear()
+
+    # -- program registry ----------------------------------------------------
+    def observe(self, key, site: str, lowerable=None, args: Tuple = (),
+                kwargs: Optional[dict] = None) -> Optional[str]:
+        """Register a dispatch program; returns its id (None when
+        disabled).  The first observation of a ``(site, key)`` — and only
+        the first, even under racing threads — captures AOT cost analysis
+        and journals ``program.compiled``; later observations are a dict
+        hit."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            rec = self._programs.get((site, key))
+            if rec is not None:
+                return rec["id"]
+            pid = program_id(site, key)
+            rec = {"id": pid, "site": site, "key": key, "cost": None,
+                   "dispatches": 0, "wall_s": 0.0, "flushed": 0}
+            self._programs[(site, key)] = rec
+        # cost capture outside the lock: lowering+compiling can take
+        # arbitrarily long and other seams must keep registering.  The
+        # record is already published, so a racing observe() of the same
+        # key returns the id immediately and never double-journals.
+        cost = aot_cost(lowerable, args, kwargs)
+        rec["cost"] = cost
+        tel.tracer().event(
+            "program.compiled", key=pid, site=site,
+            flops=(cost or {}).get("flops"),
+            bytes_accessed=(cost or {}).get("bytes_accessed"),
+            output_bytes=(cost or {}).get("output_bytes"),
+            temp_bytes=(cost or {}).get("temp_bytes"),
+            source="aot" if cost is not None else "shapes",
+            shapes=repr(key)[:512])
+        return pid
+
+    def sample(self, key, site: str, dur_s: float) -> None:
+        """Accumulate one dispatch's wall time against its program
+        (auto-registering shapes-only if the seam never observed it)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = self._programs.get((site, key))
+        if rec is None:
+            self.observe(key, site)
+            with self._lock:
+                rec = self._programs.get((site, key))
+            if rec is None:                      # disabled mid-flight
+                return
+        with self._lock:
+            rec["dispatches"] += 1
+            rec["wall_s"] += float(dur_s)
+            due = rec["dispatches"] - rec["flushed"] >= _FLUSH_EVERY
+            if due:
+                rec["flushed"] = rec["dispatches"]
+                snap = (rec["id"], rec["site"], rec["dispatches"],
+                        rec["wall_s"])
+        if due:
+            self._emit_profile(*snap)
+
+    @staticmethod
+    def _emit_profile(pid: str, site: str, dispatches: int,
+                      wall_s: float) -> None:
+        tel.tracer().event("program.profile", key=pid, site=site,
+                           dispatches=dispatches,
+                           wall_ms=round(wall_s * 1e3, 3))
+
+    def flush(self) -> None:
+        """Journal a cumulative ``program.profile`` event for every
+        program with unflushed samples — called by ``Tracer.disable``
+        before the journal closes, and usable explicitly (bench.py)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            snaps = []
+            for rec in self._programs.values():
+                if rec["dispatches"] > rec["flushed"]:
+                    rec["flushed"] = rec["dispatches"]
+                    snaps.append((rec["id"], rec["site"],
+                                  rec["dispatches"], rec["wall_s"]))
+        for snap in snaps:
+            self._emit_profile(*snap)
+
+    def stats(self) -> List[dict]:
+        """In-process program table snapshot (id, site, cost, dispatches,
+        wall_ms) — discovery order."""
+        with self._lock:
+            return [{"id": rec["id"], "site": rec["site"],
+                     "cost": dict(rec["cost"]) if rec["cost"] else None,
+                     "dispatches": rec["dispatches"],
+                     "wall_ms": round(rec["wall_s"] * 1e3, 3)}
+                    for rec in self._programs.values()]
+
+    # -- device memory gauges ------------------------------------------------
+    def sample_device_memory(self, site: str, devices=None) -> None:
+        """Sample ``memory_stats()`` of every local device into the gauge
+        table + journal (one ``device.memory`` event per device).  No-op
+        when the backend reports nothing (CPU transports return None) or
+        when this site's sampling interval (``profile.memory.sample``)
+        says skip.  Never raises — a flaky PJRT stats call must not kill
+        the dispatch path that sampled it."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if not self._mem_every:
+                return
+            n = self._mem_calls.get(site, 0)
+            self._mem_calls[site] = n + 1
+            if n % self._mem_every:
+                return
+        try:
+            if devices is None:
+                import jax
+
+                devices = jax.local_devices()
+            for dev in devices:
+                stats = getattr(dev, "memory_stats", lambda: None)()
+                if not isinstance(stats, dict):
+                    continue
+                in_use = stats.get("bytes_in_use")
+                if in_use is None:
+                    continue
+                peak = stats.get("peak_bytes_in_use", in_use)
+                label = f"{getattr(dev, 'platform', 'dev')}:" \
+                        f"{getattr(dev, 'id', 0)}"
+                with self._lock:
+                    self._gauges[(label, "bytes_in_use")] = float(in_use)
+                    self._gauges[(label, "peak_bytes")] = float(peak)
+                tel.tracer().event("device.memory", site=site, device=label,
+                                   bytes_in_use=int(in_use),
+                                   peak_bytes=int(peak))
+        except Exception:                          # pragma: no cover
+            pass
+
+    def gauges(self) -> Dict[Tuple[str, str], float]:
+        """{(device, kind): bytes} — the ``avenir_device_bytes`` gauge set
+        ``/metrics`` renders (empty until a device reports stats)."""
+        with self._lock:
+            return dict(self._gauges)
+
+
+# the registry role under its own name — the Profiler IS the
+# compiled-program registry plus the gauge table; seam docstrings and
+# the ISSUE spec refer to it by this name
+CompiledProgramRegistry = Profiler
+
+_PROFILER = Profiler()
+
+
+def profiler() -> Profiler:
+    """The process profiler (disabled, hence free, until configured)."""
+    return _PROFILER
+
+
+def configure(conf) -> Profiler:
+    """Enable the process profiler from ``profile.*`` conf keys; one dict
+    lookup when ``profile.on`` is unset.  Reached through
+    ``telemetry.spans.configure`` so every tracing entry point configures
+    both planes from the same conf."""
+    p = _PROFILER
+    if p.enabled or not conf.get_bool("profile.on", False):
+        return p
+    return p.enable(memory_sample=conf.get_int("profile.memory.sample", 1))

@@ -161,7 +161,15 @@ class Tracer:
         return self
 
     def disable(self) -> None:
-        """Turn tracing off and close the journal (tests, run teardown)."""
+        """Turn tracing off and close the journal (tests, run teardown).
+        The profiler flushes its cumulative program.profile totals into
+        the journal FIRST (its accounting rides this journal), then drops
+        its state — the two planes share one lifecycle."""
+        from avenir_tpu.telemetry import profile as _profile
+
+        prof = _profile.profiler()
+        prof.flush()
+        prof.disable()
         with self._lock:
             self.enabled = False
             self._once.clear()
@@ -295,7 +303,15 @@ def configure(conf) -> Tracer:
     Multi-process runs keep every process but 0 disabled: the journal is
     single-writer (the part-file writer protocol), and spans with nowhere
     to land would be pure overhead.  Idempotent: a pipeline and the jobs
-    it runs all call this with the same conf; the first enable wins."""
+    it runs all call this with the same conf; the first enable wins.
+
+    GraftProf (round 14) rides the same entry point: ``profile.on`` is
+    checked here too, so every seam that configures tracing — driver,
+    jobs, the serving CLI — configures the device-cost profiler from the
+    same conf (one dict lookup when off)."""
+    from avenir_tpu.telemetry import profile as _profile
+
+    _profile.configure(conf)
     t = _TRACER
     if not conf.get_bool("trace.on", False) or t.enabled:
         return t
@@ -323,7 +339,16 @@ class CompileKeyMonitor:
     ``recompile`` event carrying the fresh keys.  With ``auto_prime`` the
     first observation primes instead of counting — the batch-stream mode,
     where the first chunk's compile is the expected one and only
-    *subsequent* fresh shapes (e.g. a ragged tail chunk) are noteworthy."""
+    *subsequent* fresh shapes (e.g. a ragged tail chunk) are noteworthy.
+
+    GraftProf (round 14): every key that enters the known set — primed or
+    observed — is also registered with the
+    :class:`~avenir_tpu.telemetry.profile.CompiledProgramRegistry` under
+    this monitor's scope, so the seams that already feed the recompile
+    diff (batch chunk streams, stream panes, the serving batcher)
+    populate the compiled-program table for free: one ``program.compiled``
+    event per distinct key, recompile-monitor parity by construction (a
+    ragged tail chunk is one recompile AND one extra program)."""
 
     def __init__(self, counters=None, group: str = "Telemetry",
                  scope: str = "", auto_prime: bool = False):
@@ -335,8 +360,20 @@ class CompileKeyMonitor:
         self._primed = False
 
     def prime(self, keys: Iterable) -> None:
-        self._known |= set(keys)
+        keys = set(keys)
+        self._known |= keys
         self._primed = True
+        self._register_programs(keys)
+
+    def _register_programs(self, keys) -> None:
+        """Feed keys entering the known set to the program registry (one
+        attribute check when profiling is off)."""
+        from avenir_tpu.telemetry import profile as _profile
+
+        prof = _profile.profiler()
+        if prof.enabled:
+            for key in keys:
+                prof.observe(key, site=self.scope or self.group)
 
     @staticmethod
     def shape_key(*arrays) -> tuple:
@@ -353,6 +390,7 @@ class CompileKeyMonitor:
         if not fresh:
             return 0
         self._known |= fresh
+        self._register_programs(fresh)
         if self.auto_prime and not self._primed:
             self._primed = True
             return 0

@@ -23,6 +23,38 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 
+def percentile_of(values, q: float) -> float:
+    """THE percentile definition every surface uses — numpy's linear
+    interpolation over the given samples (round 14): ``LatencyTracker``,
+    ``StepTimer`` and the bench probes all route through here, so bench,
+    profile and serving percentiles agree by construction instead of by
+    three copies of the same formula drifting apart."""
+    arr = np.asarray(values, np.float64)
+    if arr.size == 0:
+        return 0.0
+    return float(np.percentile(arr, q))
+
+
+def percentile_summary(samples_ms,
+                       percentiles=(50.0, 95.0, 99.0)) -> Dict[str, float]:
+    """The shared wall-time summary shape: ``count``, ``mean_ms``,
+    ``p50_ms``/``p95_ms``/``p99_ms`` (configurable), ``max_ms`` — the one
+    helper behind ``StepTimer.summary`` and any probe that reports
+    percentile rows."""
+    arr = np.asarray(list(samples_ms), np.float64)
+    out: Dict[str, float] = {"count": int(arr.size)}
+    if not arr.size:
+        out["mean_ms"] = out["max_ms"] = 0.0
+        for q in percentiles:
+            out[f"p{q:g}_ms"] = 0.0
+        return out
+    out["mean_ms"] = float(arr.mean())
+    for q in percentiles:
+        out[f"p{q:g}_ms"] = percentile_of(arr, q)
+    out["max_ms"] = float(arr.max())
+    return out
+
+
 class Counters:
     """Named counters — the in-process stand-in for Hadoop job counters.
 
@@ -113,7 +145,7 @@ class LatencyTracker:
         with self._lock:
             if not self._filled:
                 return 0.0
-            return float(np.percentile(self._buf[:self._filled], q))
+            return percentile_of(self._buf[:self._filled], q)
 
     @property
     def p50_ms(self) -> float:

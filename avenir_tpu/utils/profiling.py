@@ -95,17 +95,14 @@ class StepTimer:
         return value
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        out = {}
-        for name, ms in self.samples.items():
-            arr = np.asarray(ms)
-            out[name] = {
-                "count": int(arr.size),
-                "mean_ms": float(arr.mean()),
-                "p50_ms": float(np.percentile(arr, 50)),
-                "p95_ms": float(np.percentile(arr, 95)),
-                "max_ms": float(arr.max()),
-            }
-        return out
+        """Per-step percentile rows through the ONE shared helper
+        (``utils.metrics.percentile_summary``, round 14) — StepTimer,
+        bench probes and serving latency now agree on the percentile
+        definition by construction, and StepTimer gains p99."""
+        from avenir_tpu.utils.metrics import percentile_summary
+
+        return {name: percentile_summary(ms)
+                for name, ms in self.samples.items()}
 
 
 def get_logger(name: str = "avenir_tpu", debug_on: bool = False) -> logging.Logger:

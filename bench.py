@@ -87,11 +87,18 @@ def main():
     # cost).
     import os
 
+    from avenir_tpu.telemetry import profile as prof_mod
     from avenir_tpu.telemetry import spans as tel
     tracer = tel.tracer()
+    prof = prof_mod.profiler()
     trace_dir = os.environ.get("AVENIR_TRACE_DIR")
     if trace_dir:
+        # GraftProf rides the same opt-in: the journal then carries
+        # program.compiled (AOT cost of the chunk program) +
+        # program.profile events, so `python -m avenir_tpu.telemetry
+        # profile <trace_artifact>` renders this run's roofline table
         tracer.enable(trace_dir)
+        prof.enable()
 
     # Rig-state canary FIRST (round 5): a bare-XLA 4096³ bf16 matmul,
     # measured before any framework kernel touches the chip, so every
@@ -126,6 +133,15 @@ def main():
     else:
         dcodes = jnp.asarray(codes)
     dlabels = jnp.asarray(labels)
+
+    # register THE program this bench dispatches (AOT cost analysis where
+    # the backend supports it; shapes-only otherwise — never raises)
+    bench_pkey = None
+    if prof.enabled:
+        bench_pkey = tel.CompileKeyMonitor.shape_key(dcodes, dlabels) + (
+            "nb_mi", kernel_path)
+        prof.observe(bench_pkey, site="bench.nb_mi",
+                     lowerable=pipeline_step, args=(dcodes, dlabels))
 
     # Sync discipline: jax.block_until_ready is a NO-OP on the tunnel
     # platform (measured round 2); a host fetch of a reduced scalar is the
@@ -167,6 +183,13 @@ def main():
             with tracer.span("bench.pass", attrs={"pass": i}) as sp:
                 rate, out = timed_pass()
                 sp.set("rows_per_sec", round(rate, 1))
+            if bench_pkey is not None:
+                # one timed pass = n_chunks chained dispatches of the one
+                # program — record each so the profile table's per-dispatch
+                # math (achieved = flops x dispatches / wall) is exact
+                for _ in range(n_chunks):
+                    prof.sample(bench_pkey, "bench.nb_mi",
+                                chunk / rate)
             passes.append(rate)
     rows_per_sec = float(np.median(passes))
 
@@ -176,8 +199,11 @@ def main():
     # the healthy threshold (BASELINE.md interpretation contract: matmul
     # ≲ 7 ms; the contended regime reads 167–428 ms) indicts the RIG, so
     # it documents the spread but is excluded from the conditioned median
-    # that regression comparisons use.
-    canary_healthy_ms = 7.0
+    # that regression comparisons use.  ONE constant shared with the
+    # sentinel that consumes these fields (round-14): the producer and the
+    # gate must agree on what a contended rig is.
+    from avenir_tpu.telemetry.sentinel import CANARY_HEALTHY_MS
+    canary_healthy_ms = CANARY_HEALTHY_MS
     clean = [r for c_ms, r in zip(canary_per_pass, passes)
              if c_ms <= canary_healthy_ms]
     # an all-contended run publishes NULL, never the contaminated raw
@@ -264,6 +290,20 @@ def main():
         # chained-sync discipline); tree rows tag their selection path
         from benchmarks.family_bench import families_summary
         line["families"] = families_summary(passes=2)
+
+    # GraftProf sentinel (round 14): gate this capture against the
+    # previous artifact in-process, so every BENCH_r*.json carries its
+    # own verdict (canary-flagged metrics are skipped with a verdict, not
+    # compared — the value_canary_clean convention).  AVENIR_BENCH_BASELINE
+    # points at the baseline artifact; a bands-less/missing baseline
+    # yields a no_baseline verdict, never a failed capture.
+    from avenir_tpu.telemetry import sentinel
+    baseline_path = os.environ.get(
+        "AVENIR_BENCH_BASELINE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BASELINE.json"))
+    line["regression"] = sentinel.bench_verdict(line, baseline_path)
+    prof.flush()             # cumulative program.profile into the journal
     print(json.dumps(line))
 
 

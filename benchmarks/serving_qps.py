@@ -32,6 +32,7 @@ import numpy as np
 
 from avenir_tpu.models import online_rl as orl
 from avenir_tpu.pipeline import streaming as st
+from avenir_tpu.utils.metrics import percentile_of
 
 ACTIONS = [f"a{i}" for i in range(5)]
 CONF = {"min.reward.distr.sample": 10}
@@ -276,8 +277,8 @@ def scoring_plane_section(bursts_per_bucket: int = 40):
                     lat = np.asarray(burst_lat)
                     fam_stats[str(bucket)] = {
                         "qps": round(rows_done / dt, 1),
-                        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
-                        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+                        "p50_ms": round(percentile_of(lat, 50) * 1e3, 3),
+                        "p99_ms": round(percentile_of(lat, 99) * 1e3, 3),
                     }
                 recompiles = batcher.counters.get(f"Serving.{family}",
                                                   "recompiles")
@@ -311,8 +312,8 @@ def main():
         "unit": "events/sec",
         "events_per_sec_by_workers": rates,
         "process_events_per_sec_by_workers": proc_rates,
-        "p50_latency_us": round(float(np.percentile(lats, 50)) * 1e6, 1),
-        "p99_latency_us": round(float(np.percentile(lats, 99)) * 1e6, 1),
+        "p50_latency_us": round(percentile_of(lats, 50) * 1e6, 1),
+        "p99_latency_us": round(percentile_of(lats, 99) * 1e6, 1),
         "groups": 32,
         "learner": "intervalEstimator",
         "gil_contention_1worker": gil_contention_probe(),

@@ -13,6 +13,11 @@ Two numbers per state (prints one JSON line):
   multi-second device passes), documenting why the published
   canary-clean band needs no widening with telemetry merged.
 
+Round 14 adds ``profile_site_ns_off`` — the cost of a GraftProf sample
+site (``profiler().sample``/``observe`` guard) while ``profile.on`` is
+unset: one attribute check and an early return, the same off-is-free
+contract the span sites hold.
+
 Pure host-side measurement: no accelerator work, runs anywhere.
 """
 
@@ -25,6 +30,7 @@ import time
 
 import numpy as np
 
+from avenir_tpu.telemetry.profile import Profiler
 from avenir_tpu.telemetry.spans import Tracer
 
 SPANS_PER_BATCH = 10_000
@@ -42,9 +48,21 @@ def measure_span_ns(tracer: Tracer) -> float:
     return float(np.median(rates))
 
 
+def measure_profile_site_ns(prof: Profiler) -> float:
+    key = (("probe",),)
+    rates = []
+    for _ in range(BATCHES):
+        t0 = time.perf_counter()
+        for _ in range(SPANS_PER_BATCH):
+            prof.sample(key, "probe", 0.0)
+        rates.append((time.perf_counter() - t0) / SPANS_PER_BATCH * 1e9)
+    return float(np.median(rates))
+
+
 def measure() -> dict:
     off = Tracer()                       # never enabled: the default state
     off_ns = measure_span_ns(off)
+    prof_off_ns = measure_profile_site_ns(Profiler())
 
     on = Tracer()
     with tempfile.TemporaryDirectory() as tmp:
@@ -61,6 +79,7 @@ def measure() -> dict:
     return {
         "metric": "telemetry_overhead",
         "span_ns_off": round(off_ns, 1),
+        "profile_site_ns_off": round(prof_off_ns, 1),
         "span_ns_on_journaled": round(on_ns, 1),
         "journal_bytes_per_span": round(journal_bytes
                                         / (SPANS_PER_BATCH * BATCHES), 1),

@@ -368,6 +368,11 @@ def test_whole_tree_zero_nonbaselined_findings():
     # byte-identity gate drives the per-level selection loop, where an
     # undocumented tree.hist.* key (GL004) or a sync-in-loop (GL005)
     # would hide
+    # tests/test_profile.py likewise (round 14) — the GraftProf tests
+    # drive profiled dispatch loops + the sentinel CLI, where an
+    # undocumented profile.* key (GL004) or a sync-in-loop (GL005)
+    # would hide (telemetry/profile.py + sentinel.py themselves sit
+    # inside the avenir_tpu tree the gate already walks)
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
@@ -375,7 +380,8 @@ def test_whole_tree_zero_nonbaselined_findings():
          str(REPO / "tests" / "test_stream.py"),
          str(REPO / "tests" / "test_shard.py"),
          str(REPO / "tests" / "shard_worker.py"),
-         str(REPO / "tests" / "test_tree.py")],
+         str(REPO / "tests" / "test_tree.py"),
+         str(REPO / "tests" / "test_profile.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

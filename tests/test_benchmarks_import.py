@@ -29,6 +29,23 @@ def test_benchmark_module_imports(mod):
     importlib.import_module(f"benchmarks.{mod}")
 
 
+def test_bench_sentinel_wiring_importable():
+    """bench.py now ends every capture with the in-process regression
+    sentinel; this pins the wiring it relies on (import + a verdict on a
+    minimal line) without running a measurement — the sentinel must stay
+    callable from a bare capture environment (stdlib-only)."""
+    from avenir_tpu.telemetry import sentinel
+
+    summary = sentinel.evaluate(
+        {"metric": "m", "value": 100.0, "unit": "u"},
+        {"metric": "m", "value": 100.0, "unit": "u"})
+    assert summary["verdict"] == "pass"
+    assert sentinel.exit_code("regression") == sentinel.EXIT_REGRESSION
+    assert sentinel.bench_verdict(
+        {"metric": "m", "value": 1.0}, "/nonexistent/baseline.json"
+    )["verdict"] == "no_baseline"
+
+
 def test_benchmarks_lint_clean():
     from avenir_tpu.analysis import engine
 

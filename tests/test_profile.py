@@ -1,0 +1,623 @@
+"""GraftProf (avenir_tpu/telemetry/profile + sentinel) — the device-cost
+profiling plane (round 14).
+
+The acceptance contract (ISSUE 9): with ``trace.on`` unset nothing is
+ever created; with profiling on, one ``program.compiled`` event per
+*distinct* compile key (recompile-monitor parity: a ragged tail chunk is
+one recompile AND one extra program), the ``profile`` CLI renders
+dispatch counts + an achieved-vs-canary-peak column from a real traced
+run, device-memory gauges reach ``/metrics`` as ``avenir_device_bytes``,
+and the sentinel exits 0 / 1 / 3 on clean / regressed / canary-flagged
+captures.  Around it: the AOT cost capture (guarded, shapes-only
+degrade), the registry under racing dispatch threads, the post-hoc
+``metrics`` CLI, the shared percentile helper, and the driver's
+``trace.xla.dir`` per-stage capture seam.
+"""
+
+import contextlib
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.csv_io import write_csv
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.jobs import get_job
+from avenir_tpu.telemetry import profile as prof_mod
+from avenir_tpu.telemetry import sentinel
+from avenir_tpu.telemetry import spans as tel
+from avenir_tpu.telemetry.__main__ import main as tel_main
+from avenir_tpu.telemetry.journal import read_events
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes():
+    """Tracer AND profiler are process-wide; every test starts and ends
+    with both disabled (Tracer.disable tears the profiler down too)."""
+    tel.tracer().disable()
+    assert not prof_mod.profiler().enabled
+    yield
+    tel.tracer().disable()
+
+
+@pytest.fixture(scope="module")
+def churn_ws(tmp_path_factory):
+    root = tmp_path_factory.mktemp("graftprof")
+    j = lambda *p: str(root.joinpath(*p))
+    rows = generate_churn(400, seed=11)
+    write_csv(j("train.csv"), rows[:320])
+    root.joinpath("churn.json").write_text(json.dumps(CHURN_SCHEMA_JSON))
+    return {"j": j, "schema": j("churn.json")}
+
+
+class _FakeDevice:
+    """memory_stats like a TPU PJRT device (CPU returns None)."""
+
+    def __init__(self, dev_id=0, in_use=1 << 20, peak=2 << 20):
+        self.platform = "faketpu"
+        self.id = dev_id
+        self._stats = {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+    def memory_stats(self):
+        return self._stats
+
+
+# ---------------------------------------------------------------------------
+# the registry: off is free, one event per key, AOT cost, races
+# ---------------------------------------------------------------------------
+
+def test_profiler_off_is_free_and_records_nothing():
+    prof = prof_mod.profiler()
+    assert not prof.enabled
+    assert prof.observe(("k",), site="s") is None
+    prof.sample(("k",), "s", 0.1)
+    prof.sample_device_memory("s", devices=[_FakeDevice()])
+    assert prof.stats() == []
+    assert prof.gauges() == {}
+
+
+def test_registry_one_compiled_event_per_distinct_key(tmp_path):
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof = prof_mod.profiler().enable()
+    with tracer.span("run"):
+        for _ in range(3):
+            prof.observe(("k1",), site="seam")
+        prof.observe(("k2",), site="seam")
+        prof.observe(("k1",), site="other")      # same key, other site: new
+        prof.sample(("k1",), "seam", 0.010)
+        prof.sample(("k1",), "seam", 0.020)
+    path = tracer.journal_path
+    tel.tracer().disable()                       # flushes program.profile
+    events = read_events(path)
+    compiled = [e for e in events if e["ev"] == "program.compiled"]
+    assert len(compiled) == 3                    # (seam,k1) (seam,k2) (other,k1)
+    assert len({e["key"] for e in compiled}) == 3
+    totals = {e["key"]: e for e in events if e["ev"] == "program.profile"}
+    k1 = prof_mod.program_id("seam", ("k1",))
+    assert totals[k1]["dispatches"] == 2
+    assert totals[k1]["wall_ms"] == pytest.approx(30.0, abs=1.0)
+
+
+def test_registry_aot_cost_capture_and_shapes_only_degrade(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof = prof_mod.profiler().enable()
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((32, 32), jnp.float32)
+    with tracer.span("run"):
+        prof.observe(("jit", (32, 32)), site="aot", lowerable=f, args=(x,))
+        prof.observe(("bare",), site="aot")      # no lowerable: shapes-only
+        # a lowerable that refuses its operands degrades, never raises
+        prof.observe(("bad",), site="aot", lowerable=f, args=("nonsense",))
+    path = tracer.journal_path
+    tel.tracer().disable()
+    by_shapes = {e["shapes"]: e for e in read_events(path)
+                 if e["ev"] == "program.compiled"}
+    aot = by_shapes["('jit', (32, 32))"]
+    assert aot["source"] == "aot"
+    assert aot["flops"] == pytest.approx(2 * 32 ** 3, rel=0.5)
+    assert aot["bytes_accessed"] > 0
+    assert aot["output_bytes"] >= 32 * 32 * 4
+    for shapes in ("('bare',)", "('bad',)"):
+        rec = by_shapes[shapes]
+        assert rec["source"] == "shapes"
+        assert rec["flops"] is None
+
+
+def test_registry_threaded_dispatch_race_one_event_per_key(tmp_path):
+    """Serving batcher and stream pane seams register concurrently (each
+    through its own CompileKeyMonitor) — exactly one program.compiled per
+    (site, key) must survive the race, and samples must sum exactly."""
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof = prof_mod.profiler().enable()
+    from avenir_tpu.utils.metrics import Counters
+
+    counters = Counters()
+    serving = tel.CompileKeyMonitor(counters, group="Serving.m", scope="m")
+    stream = tel.CompileKeyMonitor(counters, group="Stream",
+                                   scope="stream.pane")
+    keys = [((1024, "int32"),), ((512, "int32"),), ((64, "int32"),)]
+    per_thread = 200
+    errs = []
+
+    def serving_thread():
+        try:
+            for i in range(per_thread):
+                serving.observe([keys[i % len(keys)]])
+                prof.sample(keys[i % len(keys)], "m", 0.001)
+        except BaseException as e:                    # surfaced below
+            errs.append(e)
+
+    def pane_thread():
+        try:
+            for i in range(per_thread):
+                stream.observe([keys[(i + 1) % len(keys)]])
+                prof.sample(keys[(i + 1) % len(keys)], "stream.pane", 0.001)
+        except BaseException as e:
+            errs.append(e)
+
+    threads = ([threading.Thread(target=serving_thread) for _ in range(4)]
+               + [threading.Thread(target=pane_thread) for _ in range(4)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    # every sample landed exactly once (checked before disable clears it)
+    stats = prof.stats()
+    assert sum(r["dispatches"] for r in stats) == 8 * per_thread
+    path = tracer.journal_path
+    tel.tracer().disable()                            # flushes final totals
+    events = read_events(path)
+    compiled = [e for e in events if e["ev"] == "program.compiled"]
+    # one per (site, key): 2 sites x 3 keys, no duplicates under the race
+    assert len(compiled) == 6
+    assert len({(e["site"], e["key"]) for e in compiled}) == 6
+    totals = {e["key"]: e["dispatches"] for e in events
+              if e["ev"] == "program.profile"}
+    assert sum(totals.values()) == 8 * per_thread
+
+
+# ---------------------------------------------------------------------------
+# the chunk-stream seam: recompile-monitor parity, span program attrs
+# ---------------------------------------------------------------------------
+
+def test_chunk_stream_program_parity_with_recompile_monitor(churn_ws,
+                                                            tmp_path):
+    """320 rows at 150/chunk → 150+150+20: TWO distinct dispatch shapes.
+    One program.compiled per distinct key, and the ragged tail is exactly
+    one recompile — programs == recompiles + 1, pinned."""
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    counters = get_job("BayesianDistribution").run(
+        JobConfig({"feature.schema.file.path": schema,
+                   "stream.chunk.rows": "150",
+                   "trace.on": "true", "profile.on": "true",
+                   "trace.journal.dir": str(tmp_path / "tel")}),
+        j("train.csv"), str(tmp_path / "nb_model"))
+    path = tel.tracer().journal_path
+    tel.tracer().disable()
+    events = read_events(path)
+    programs = [e for e in events if e["ev"] == "program.compiled"
+                and e["site"] == "stream"]
+    assert len(programs) == 2
+    assert counters.get("Telemetry", "recompiles") == 1
+    # chunk spans carry program=<id> attrs resolving to registered ids
+    ids = {e["key"] for e in programs}
+    chunk_spans = [e for e in events if e["ev"] == "span.open"
+                   and e["name"] == "chunk"]
+    assert len(chunk_spans) == 3
+    assert {e["attrs"]["program"] for e in chunk_spans} == ids
+    # cumulative totals flushed at disable cover every chunk dispatch
+    totals = {e["key"]: e["dispatches"] for e in events
+              if e["ev"] == "program.profile" and e["site"] == "stream"}
+    assert sum(totals.values()) == 3
+
+
+def test_trace_without_profile_registers_no_programs(churn_ws, tmp_path):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    get_job("BayesianDistribution").run(
+        JobConfig({"feature.schema.file.path": schema,
+                   "stream.chunk.rows": "150",
+                   "trace.on": "true",
+                   "trace.journal.dir": str(tmp_path / "tel")}),
+        j("train.csv"), str(tmp_path / "nb_model"))
+    path = tel.tracer().journal_path
+    tel.tracer().disable()
+    evs = {e["ev"] for e in read_events(path)}
+    assert "program.compiled" not in evs
+    assert "program.profile" not in evs
+    assert "device.memory" not in evs
+
+
+# ---------------------------------------------------------------------------
+# device-memory gauges → journal + /metrics exposition
+# ---------------------------------------------------------------------------
+
+def test_device_memory_gauges_journal_and_prometheus(tmp_path):
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof = prof_mod.profiler().enable()
+    with tracer.span("run"):
+        prof.sample_device_memory(
+            "pane", devices=[_FakeDevice(0, in_use=100, peak=200),
+                             _FakeDevice(1, in_use=300, peak=400)])
+    gauges = prof.gauges()
+    assert gauges[("faketpu:0", "bytes_in_use")] == 100.0
+    assert gauges[("faketpu:1", "peak_bytes")] == 400.0
+    from avenir_tpu.telemetry.export import prometheus_text
+
+    text = prometheus_text(device_bytes=gauges)
+    assert ('avenir_device_bytes{device="faketpu:0",kind="bytes_in_use"} '
+            '100') in text
+    assert "# TYPE avenir_device_bytes gauge" in text
+    path = tracer.journal_path
+    tel.tracer().disable()
+    mem = [e for e in read_events(path) if e["ev"] == "device.memory"]
+    assert {(e["device"], e["bytes_in_use"], e["peak_bytes"])
+            for e in mem} == {("faketpu:0", 100, 200),
+                              ("faketpu:1", 300, 400)}
+    assert all(e["site"] == "pane" for e in mem)
+
+
+def test_metrics_route_exposes_device_bytes(churn_ws, tmp_path):
+    """The LIVE serving frontend's /metrics page carries the GraftProf
+    gauges (acceptance: avenir_device_bytes on /metrics)."""
+    import urllib.request
+
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    get_job("BayesianDistribution").run(
+        JobConfig({"feature.schema.file.path": schema}),
+        j("train.csv"), str(tmp_path / "nb_model"))
+    from avenir_tpu.serving.batcher import BucketedMicrobatcher
+    from avenir_tpu.serving.frontend import ScoreHTTPServer
+    from avenir_tpu.serving.registry import ModelRegistry
+
+    conf = JobConfig({"feature.schema.file.path": schema,
+                      "serve.models": "naiveBayes",
+                      "bayesian.model.file.path": str(tmp_path / "nb_model"),
+                      "serve.bucket.sizes": "1,4"})
+    prof = prof_mod.profiler().enable()
+    prof.sample_device_memory("swap", devices=[_FakeDevice(in_use=777)])
+    registry = ModelRegistry.from_conf(conf)
+    with BucketedMicrobatcher.from_conf(registry, conf) as batcher, \
+            ScoreHTTPServer(batcher) as srv:
+        host, port = srv.address
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics").read().decode()
+    assert ('avenir_device_bytes{device="faketpu:0",kind="bytes_in_use"} '
+            '777') in body
+    assert "# TYPE avenir_device_bytes gauge" in body
+
+
+def test_device_memory_sampling_interval(tmp_path):
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof = prof_mod.profiler().enable(memory_sample=3)
+    with tracer.span("run"):
+        for _ in range(7):                   # calls 0..6 → sampled 0, 3, 6
+            prof.sample_device_memory("chunk", devices=[_FakeDevice()])
+    path = tracer.journal_path
+    tel.tracer().disable()
+    assert len([e for e in read_events(path)
+                if e["ev"] == "device.memory"]) == 3
+
+
+def test_cpu_devices_without_stats_are_a_noop(tmp_path):
+    """This container's CPU backend reports memory_stats() = None — the
+    sampler must silently skip it (acceptance: 'no-op where
+    unsupported'), never raise into the dispatch path that sampled."""
+    import jax
+
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof = prof_mod.profiler().enable()
+    with tracer.span("run"):
+        prof.sample_device_memory("chunk")   # real local devices
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:                               # stats-less backend: no gauges
+        assert prof.gauges() == {}
+    path = tracer.journal_path
+    tel.tracer().disable()
+    if on_cpu:
+        assert [e for e in read_events(path)
+                if e["ev"] == "device.memory"] == []
+
+
+# ---------------------------------------------------------------------------
+# the profile + metrics CLIs over a real traced run
+# ---------------------------------------------------------------------------
+
+def test_profile_cli_renders_roofline_table(churn_ws, tmp_path, capsys):
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    get_job("BayesianDistribution").run(
+        JobConfig({"feature.schema.file.path": schema,
+                   "stream.chunk.rows": "150",
+                   "trace.on": "true", "profile.on": "true",
+                   "trace.journal.dir": str(tmp_path / "tel")}),
+        j("train.csv"), str(tmp_path / "nb_model"))
+    # a rig canary in the journal is the MFU denominator (bench.py
+    # journals these per pass; here one is enough)
+    tel.tracer().event("canary", ms=5.0, when="probe")
+    path = tel.tracer().journal_path
+    tel.tracer().disable()
+    assert tel_main(["profile", path]) == 0
+    out = capsys.readouterr().out
+    assert "MFU%" in out and "disp" in out and "GFLOP/s" in out
+    assert "peak:" in out and "TFLOP/s" in out       # canary-derived
+    assert "ESTIMATES" in out                        # the honesty caveat
+    # the stream programs appear with their dispatch counts
+    lines = [ln for ln in out.splitlines() if " stream " in ln]
+    assert lines and sum(int(ln.split()[2]) for ln in lines) == 3
+
+
+def test_profile_cli_without_programs_says_so(tmp_path, capsys):
+    tracer = tel.tracer().enable(str(tmp_path))
+    with tracer.span("run"):
+        pass
+    path = tracer.journal_path
+    tel.tracer().disable()
+    assert tel_main(["profile", path]) == 0
+    assert "no program.compiled" in capsys.readouterr().out
+
+
+def test_metrics_cli_post_hoc_prometheus(tmp_path, capsys):
+    from avenir_tpu.utils.metrics import Counters
+
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof = prof_mod.profiler().enable()
+    counters = Counters()
+    counters.increment("Records", "Processed", 42)
+    with tracer.span("run"):
+        tracer.counters("stage1", counters)
+        counters.increment("Records", "Processed", 8)
+        tracer.counters("pipeline", counters)        # LAST snapshot wins
+        tracer.gauge("serve.queue.m", 2)
+        prof.sample_device_memory("pane", devices=[_FakeDevice()])
+    path = tracer.journal_path
+    tel.tracer().disable()
+    assert tel_main(["metrics", path]) == 0
+    out = capsys.readouterr().out
+    assert "# last counter snapshot scope: pipeline" in out
+    assert ('avenir_counter_total{group="Records",name="Processed"} 50'
+            in out)
+    assert 'avenir_gauge{name="serve.queue.m"} 2' in out
+    assert 'avenir_device_bytes{device="faketpu:0",kind="bytes_in_use"}' \
+        in out
+
+
+# ---------------------------------------------------------------------------
+# the perf-regression sentinel
+# ---------------------------------------------------------------------------
+
+def _bench_line(value=200.0, clean=True, fam_tree=10.0, knn=5000.0):
+    """A bench-artifact-shaped line; ``clean=False`` = an all-contended
+    rig capture (every metric canary-flagged the way its producer flags
+    it: primary via value_canary_clean null, knn via the scalar matmul
+    field, family rows via the per-pass canary list)."""
+    return {
+        "metric": "nb_mi_pipeline_throughput",
+        "value": value, "unit": "rows/sec/chip",
+        "value_canary_clean": value if clean else None,
+        "canary_clean_passes": 3 if clean else 0,
+        "canary_matmul_4096_bf16_ms": 1.2 if clean else 180.0,
+        "knn": {"value": knn, "unit": "queries/sec/chip",
+                "canary_matmul_4096_bf16_ms": 1.0 if clean else 190.0},
+        "families": {"tree": {
+            "value": fam_tree, "unit": "rows/sec/chip",
+            "canary_per_pass_ms": [1.1, 0.9] if clean else [180.0, 167.0]}},
+    }
+
+
+def test_sentinel_clean_capture_passes():
+    summary = sentinel.evaluate(_bench_line(value=195.0),
+                                _bench_line(value=200.0))
+    assert summary["verdict"] == "pass"
+    assert summary["compared"] == 3            # primary + knn + tree
+    assert summary["regressed"] == [] and summary["skipped"] == []
+
+
+def test_sentinel_flags_synthetic_regression():
+    # a −30% primary against the default 25% band, tree/knn steady
+    summary = sentinel.evaluate(_bench_line(value=140.0),
+                                _bench_line(value=200.0))
+    assert summary["verdict"] == "regression"
+    assert summary["regressed"] == ["nb_mi_pipeline_throughput"]
+    row = next(r for r in summary["rows"]
+               if r["metric"] == "nb_mi_pipeline_throughput")
+    assert row["verdict"] == "regression"
+    assert row["ratio"] == pytest.approx(0.7)
+
+
+def test_sentinel_canary_flagged_capture_skips_not_compares():
+    """A rig-contended capture (value_canary_clean null) must produce a
+    skip verdict — comparing contaminated numbers would either mask a
+    real regression or invent one."""
+    summary = sentinel.evaluate(_bench_line(clean=False),
+                                _bench_line(value=200.0))
+    assert summary["verdict"] == "skip"
+    assert set(summary["skipped"]) == {"nb_mi_pipeline_throughput",
+                                       "knn", "families.tree"}
+    assert summary["compared"] == 0 and not summary["missing"]
+
+
+def test_sentinel_flags_family_rows_via_per_pass_canaries():
+    """family_bench rows carry canary_per_pass_ms (a LIST), not the
+    scalar matmul field — a family row with no rig-clean pass must be
+    skipped, not compared (review finding: the field-name mismatch made
+    contended-rig family captures read as regressions)."""
+    current = _bench_line()
+    current["families"]["tree"] = {
+        "value": 2.0, "unit": "rows/sec/chip",
+        "canary_per_pass_ms": [180.0, 210.5]}        # contended rig
+    summary = sentinel.evaluate(current, _bench_line(fam_tree=10.0))
+    assert "families.tree" in summary["skipped"]
+    assert "families.tree" not in summary["regressed"]
+    # one clean reading in the list ⇒ the row IS comparable
+    current["families"]["tree"]["canary_per_pass_ms"] = [180.0, 1.5]
+    summary = sentinel.evaluate(current, _bench_line(fam_tree=10.0))
+    assert "families.tree" in summary["regressed"]   # 2.0 vs 10.0: real
+
+
+def test_sentinel_missing_gated_metric_fails_like_regression():
+    """A capture that silently stops emitting a baseline-gated metric
+    (e.g. the families section fails to build) must not pass by
+    omission (review finding)."""
+    current = _bench_line()
+    del current["families"]
+    summary = sentinel.evaluate(current, _bench_line())
+    assert summary["verdict"] == "regression"
+    assert summary["missing"] == ["families.tree"]
+    row = next(r for r in summary["rows"] if r["metric"] == "families.tree")
+    assert row["verdict"] == "missing"
+
+
+def test_sentinel_cli_bad_tolerance_exits_usage_not_regression(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_bench_line()))
+    assert tel_main(["regress", str(base), "--baseline", str(base),
+                     "--tolerance", "m=abc"]) == 2
+    assert tel_main(["regress", str(base), "--baseline", str(base),
+                     "--tolerance", "m"]) == 2
+
+
+def test_sentinel_per_metric_tolerance_and_wrapped_artifacts():
+    # the driver wraps captures as {"parsed": line}; families.tree −40%
+    # passes only under a widened per-metric band
+    current = {"parsed": _bench_line(fam_tree=6.0)}
+    baseline = {"parsed": _bench_line(fam_tree=10.0)}
+    tight = sentinel.evaluate(current, baseline)
+    assert tight["regressed"] == ["families.tree"]
+    loose = sentinel.evaluate(current, baseline,
+                              per_metric={"families.tree": 50.0})
+    assert loose["verdict"] == "pass"
+
+
+def test_sentinel_bench_verdict_never_raises(tmp_path):
+    # missing baseline → no_baseline, the capture still publishes
+    out = sentinel.bench_verdict(_bench_line(), str(tmp_path / "nope.json"))
+    assert out["verdict"] == "no_baseline"
+    # a bands-less BASELINE.json (metric is a description, no value)
+    bands_less = tmp_path / "BASELINE.json"
+    bands_less.write_text(json.dumps({"metric": "prose", "published": {}}))
+    out = sentinel.bench_verdict(_bench_line(), str(bands_less))
+    assert out["verdict"] == "no_baseline"
+
+
+def test_sentinel_cli_exit_codes(tmp_path, capsys):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_bench_line(value=200.0)))
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(_bench_line(value=198.0)))
+    regressed = tmp_path / "regressed.json"
+    regressed.write_text(json.dumps(_bench_line(value=140.0)))
+    flagged = tmp_path / "flagged.json"
+    flagged.write_text(json.dumps(_bench_line(clean=False)))
+
+    assert tel_main(["regress", str(clean),
+                     "--baseline", str(base)]) == sentinel.EXIT_PASS
+    assert tel_main(["regress", str(regressed),
+                     "--baseline", str(base)]) == sentinel.EXIT_REGRESSION
+    assert tel_main(["regress", str(flagged),
+                     "--baseline", str(base)]) == sentinel.EXIT_SKIP
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "skipped_canary" in out
+    # per-metric tolerance flag widens the band through the CLI too
+    assert tel_main(["regress", str(regressed), "--baseline", str(base),
+                     "--tolerance", "nb_mi_pipeline_throughput=40",
+                     "--json"]) == sentinel.EXIT_PASS
+
+
+def test_sentinel_journals_golden_verdict(tmp_path):
+    tracer = tel.tracer().enable(str(tmp_path))
+    with tracer.span("bench"):
+        sentinel.bench_verdict(_bench_line(), str(tmp_path / "missing"))
+    path = tracer.journal_path
+    tel.tracer().disable()
+    evs = [e for e in read_events(path) if e["ev"] == "bench.regression"]
+    assert len(evs) == 1 and evs[0]["verdict"] == "no_baseline"
+
+
+# ---------------------------------------------------------------------------
+# satellite: one shared percentile definition (StepTimer gains p99)
+# ---------------------------------------------------------------------------
+
+def test_step_timer_p99_agrees_with_shared_helper():
+    from avenir_tpu.utils.metrics import percentile_of
+    from avenir_tpu.utils.profiling import StepTimer
+
+    timer = StepTimer()
+    samples = [float(v) for v in range(1, 101)]      # 1..100 ms
+    timer.samples["probe"] = list(samples)
+    s = timer.summary()["probe"]
+    assert s["count"] == 100
+    assert s["p99_ms"] == percentile_of(samples, 99.0)
+    assert s["p50_ms"] == percentile_of(samples, 50.0)
+    assert s["p95_ms"] == percentile_of(samples, 95.0)   # pre-existing keys
+    assert s["max_ms"] == 100.0 and s["mean_ms"] == pytest.approx(50.5)
+
+
+def test_latency_tracker_routes_through_shared_helper():
+    from avenir_tpu.utils.metrics import LatencyTracker, percentile_of
+
+    tracker = LatencyTracker()
+    values = [0.001 * v for v in range(1, 51)]
+    for v in values:
+        tracker.record(v)
+    assert tracker.percentile(99.0) == percentile_of(values, 99.0)
+    assert tracker.p99_ms == percentile_of(values, 99.0) * 1e3
+
+
+# ---------------------------------------------------------------------------
+# satellite: the driver's per-stage XProf capture seam (trace.xla.dir)
+# ---------------------------------------------------------------------------
+
+def test_driver_xla_trace_per_stage_subdirs(churn_ws, tmp_path,
+                                            monkeypatch):
+    from avenir_tpu.pipeline.driver import Pipeline, Stage
+    from avenir_tpu.utils import profiling
+
+    captured = []
+
+    @contextlib.contextmanager
+    def fake_trace(log_dir):
+        captured.append(log_dir)
+        yield
+
+    monkeypatch.setattr(profiling, "trace", fake_trace)
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    xla_dir = str(tmp_path / "xla")
+    conf = JobConfig({"feature.schema.file.path": schema,
+                      "stream.chunk.rows": "150",
+                      "trace.on": "true",
+                      "trace.journal.dir": str(tmp_path / "tel"),
+                      "trace.xla.dir": xla_dir})
+    p = Pipeline(str(tmp_path / "ws"), conf)
+    p.bind("train", j("train.csv"))
+    p.add(Stage("nb", "BayesianDistribution", "train", "nb_model"))
+    p.add(Stage("mi", "MutualInformation", "train", "mi_out"))
+    p.run()
+    path = tel.tracer().journal_path
+    tel.tracer().disable()
+    # NB+MI fuse into one SharedScan group — ONE capture, named for the
+    # group head, under its own subdir of trace.xla.dir
+    assert captured == [f"{xla_dir}/nb"]
+    xla_events = [e for e in read_events(path) if e["ev"] == "xla.trace"]
+    assert [(e["stage"], e["dir"]) for e in xla_events] == \
+        [("nb", f"{xla_dir}/nb")]
+
+
+def test_driver_xla_trace_off_by_default(churn_ws, tmp_path, monkeypatch):
+    from avenir_tpu.pipeline.driver import Pipeline, Stage
+    from avenir_tpu.utils import profiling
+
+    def boom(log_dir):                               # must never be reached
+        raise AssertionError("xla trace engaged without trace.xla.dir")
+
+    monkeypatch.setattr(profiling, "trace", boom)
+    j, schema = churn_ws["j"], churn_ws["schema"]
+    p = Pipeline(str(tmp_path / "ws"),
+                 JobConfig({"feature.schema.file.path": schema}))
+    p.bind("train", j("train.csv"))
+    p.add(Stage("nb", "BayesianDistribution", "train", "nb_model"))
+    p.run()

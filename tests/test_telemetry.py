@@ -263,7 +263,36 @@ GOLDEN_EVENT_KEYS = {
     # ran on (device kind, mesh shape, axis names)
     "shard.topology": {"ev", "ts", "trace", "span", "devices",
                        "device_kind", "mesh", "axes"},
+    # GraftProf (round 14): the compiled-program registry (one event per
+    # distinct (site, compile key) with AOT cost fields — null when the
+    # backend degrades to shapes-only), the cumulative per-program wall
+    # totals, device-memory gauges, the bench sentinel's verdict, and the
+    # per-stage XProf capture path — docs/observability.md event table
+    "program.compiled": {"ev", "ts", "trace", "span", "key", "site",
+                         "flops", "bytes_accessed", "output_bytes",
+                         "temp_bytes", "source", "shapes"},
+    "program.profile": {"ev", "ts", "trace", "span", "key", "site",
+                        "dispatches", "wall_ms"},
+    "device.memory": {"ev", "ts", "trace", "span", "site", "device",
+                      "bytes_in_use", "peak_bytes"},
+    "bench.regression": {"ev", "ts", "trace", "span", "verdict", "compared",
+                         "regressed", "skipped", "missing", "baseline"},
+    "xla.trace": {"ev", "ts", "trace", "span", "stage", "dir"},
 }
+
+
+class _FakeDevice:
+    """A device whose memory_stats reports like a TPU PJRT client (the
+    container's CPU backend returns None, so gauge tests inject this)."""
+
+    platform = "faketpu"
+    id = 0
+
+    def __init__(self, in_use=1 << 20, peak=2 << 20):
+        self._stats = {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+    def memory_stats(self):
+        return self._stats
 
 
 def test_golden_event_shapes(tmp_path):
@@ -292,6 +321,19 @@ def test_golden_event_shapes(tmp_path):
                      family="naiveBayes", warmed=True)
         tracer.event("shard.topology", devices=8, device_kind="cpu",
                      mesh={"data": 8}, axes=["data"])
+        # GraftProf events ride the REAL emission paths
+        from avenir_tpu.telemetry import profile as prof_mod
+        from avenir_tpu.telemetry import sentinel
+
+        prof = prof_mod.profiler().enable()
+        prof.observe(("gk",), site="golden")           # shapes-only record
+        prof.sample(("gk",), "golden", 0.002)
+        prof.flush()                                   # → program.profile
+        prof.sample_device_memory("golden", devices=[_FakeDevice()])
+        sentinel.journal_verdict(
+            {"verdict": "pass", "compared": 1, "regressed": [],
+             "skipped": []}, "BASELINE.json")
+        tracer.event("xla.trace", stage="s1", dir="/tmp/xla/s1")
     path = tracer.journal_path
     tel.tracer().disable()
     seen = {}
