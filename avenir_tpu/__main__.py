@@ -87,12 +87,9 @@ def main(argv: List[str]) -> int:
         except Exception:
             pass                       # cache is an optimization, never fatal
     counters = job.run(conf, positional[0], positional[1])
-    # journal the final counter snapshot under the job's name so a traced
-    # one-shot run is scrapeable post-hoc (`telemetry metrics <journal>`
-    # renders the journal's LAST snapshot) — no-op when tracing is off
-    from avenir_tpu.telemetry import spans as tel
-
-    tel.tracer().counters(job_name, counters)
+    # (the final counter snapshot is journaled by Job.run itself under
+    # the job's name — round 15 moved it there so multi-process workers
+    # and Python-API callers snapshot too, not just this CLI)
     for group, vals in sorted(counters.as_dict().items()):
         print(group)
         for k, v in sorted(vals.items()):

@@ -122,6 +122,20 @@ class Job:
         with tracer.span(f"job.{self.name or type(self).__name__}",
                          attrs=attrs):
             self.execute(conf, input_path, output_path, counters)
+        # GraftFleet (round 15): journal this job's final counter
+        # snapshot under the job name — in a multi-process run EVERY
+        # process's shard then carries its own totals (per-process
+        # attribution in the merged fleet view, and the data the SLO
+        # evaluator's counter metrics read), and a standalone Python-API
+        # run becomes scrapeable post-hoc (`telemetry metrics`) without
+        # going through the CLI wrapper.  Only when this job is the
+        # OUTERMOST traced unit: nested under an enclosing span (a
+        # pipeline stage), the driver already journals the stage
+        # snapshot, and a second identically-valued series would both
+        # double the CLI's counter-delta report and double-count in the
+        # SLO evaluator's per-writer totals.
+        if tracer.enabled and tracer.current() is None:
+            tracer.counters(self.name or type(self).__name__, counters)
         # GraftProf: flush cumulative program wall totals at the job
         # boundary — a one-shot CLI run exits without ever calling
         # Tracer.disable, and totals below the periodic flush threshold

@@ -287,6 +287,7 @@ def all_process_sum_state(state: dict) -> dict:
     if jax.process_count() == 1:
         return {k: np.asarray(v) for k, v in state.items()}
     import json as _json
+    import time as _time
 
     from jax.experimental import multihost_utils
 
@@ -303,11 +304,28 @@ def all_process_sum_state(state: dict) -> dict:
         raise ValueError(
             f"accumulator payload {len(payload)} bytes exceeds the int32 "
             "length-gather limit; shard the state across keys/jobs")
+    # GraftFleet (round 15): the gather below is where a straggling PEER
+    # surfaces on this process — every process enters it, so the wall a
+    # fast process spends here is mostly waiting for the slowest one.
+    # Journal it as a collective.wait event (per-process shards make the
+    # asymmetry readable in the merged fleet view: the straggler's wait
+    # is short, everyone else's is long).  Telemetry wall clock only —
+    # never enters the collective payload, so process divergence is
+    # impossible by construction.
+    t0 = _time.perf_counter()   # graftlint: disable=GL001
     lens = np.asarray(multihost_utils.process_allgather(
         np.array([len(payload)], np.int32))).reshape(-1)
     buf = np.zeros(int(lens.max()), np.uint8)
     buf[:len(payload)] = np.frombuffer(payload, np.uint8)
     gathered = np.asarray(multihost_utils.process_allgather(buf))
+    wait_ms = (_time.perf_counter() - t0) * 1e3   # graftlint: disable=GL001
+    from avenir_tpu.telemetry import spans as _tel
+
+    _tracer = _tel.tracer()
+    if _tracer.enabled:
+        _tracer.event("collective.wait", site="all_process_sum_state",
+                      wall_ms=round(wait_ms, 3), bytes=len(payload),
+                      procs=int(gathered.shape[0]))
     out: dict = {}
     for p in range(gathered.shape[0]):
         raw = gathered[p, :int(lens[p])].tobytes()

@@ -55,6 +55,16 @@ class ShardSpec:
     mesh: object                      # jax.sharding.Mesh (1-D data mesh)
     data_axis: str = "data"
     quantized: bool = False
+    # GraftFleet straggler attribution (round 15; parallel/skew.py —
+    # active only under profile.on): sampled per-device wall probe around
+    # the fused fold, flagging chunks whose max/min per-device time
+    # exceeds the threshold.  The fault.* pair injects a synthetic
+    # straggler publish-side (test/bench knob, the stream.fault.*
+    # discipline).
+    skew_threshold: float = 1.5
+    skew_sample: int = 1
+    skew_fault_device: int = -1
+    skew_fault_ms: float = 0.0
 
     @staticmethod
     def requested(conf) -> bool:
@@ -99,7 +109,13 @@ class ShardSpec:
         return cls(mesh=make_mesh((axis,), shape=(n,), devices=avail[:n]),
                    data_axis=axis,
                    quantized=conf.get_bool("shard.allreduce.quantized",
-                                           False))
+                                           False),
+                   skew_threshold=conf.get_float("shard.skew.threshold",
+                                                 1.5),
+                   skew_sample=conf.get_int("shard.skew.sample", 1),
+                   skew_fault_device=conf.get_int("shard.skew.fault.device",
+                                                  -1),
+                   skew_fault_ms=conf.get_float("shard.skew.fault.ms", 0.0))
 
     # -- identity -------------------------------------------------------------
     @property

@@ -1,0 +1,185 @@
+"""GraftFleet straggler/skew attribution — per-device wall sampling for
+the mesh-sharded SharedScan (round 15).
+
+The fused ``collectives.sharded_scan_step`` dispatch hides per-device
+behavior by construction: its outputs are psum'd, so every device's copy
+becomes ready only after the SLOWEST device has contributed — host-side
+timing of the fused program can say "this chunk was slow" but never
+"device 3 made it slow".  Multi-host TPU practice treats exactly that
+attribution as table stakes for scaling claims (pjit/TPUv4 scaling
+discipline, arXiv 2204.06514): a fleet with one throttled or contended
+chip otherwise reads as a uniformly slow fleet.
+
+This module measures the PRE-collective per-device time with a sampled
+probe dispatch:
+
+- :func:`skew_probe_step` compiles the same per-device Pallas gram the
+  fused step runs (same kernel, same per-device rows) but with NO
+  collective and the output left **sharded** over the data axis — so
+  device *d*'s output shard becomes ready exactly when device *d*
+  finishes its local chunk work;
+- :class:`DeviceSkewProbe` dispatches it every ``shard.skew.sample``-th
+  chunk (behind ``profile.on`` — off means the fold pays one attribute
+  check and the probe program is never even built), blocks on every
+  device's shard from its own thread (``block_until_ready`` releases the
+  GIL, so each thread observes its device's true completion), and
+  publishes:
+
+  - a ``Shard::skew.pct`` gauge counter (latest max/min ratio × 100) and
+    a ``shard.skew.ratio`` journal gauge,
+  - one golden-schema'd ``shard.skew`` journal event per sampled chunk
+    carrying the full per-device ms distribution, ``flagged`` when the
+    max/min ratio exceeds ``shard.skew.threshold`` (plus a
+    ``Shard::skew.flagged`` counter — the straggler alarm),
+  - rendered post-hoc by ``python -m avenir_tpu.telemetry skew
+    <journal>`` (per-device distribution, slowest device highlighted).
+
+Honesty note: the probe is an EXTRA dispatch of the gram kernel — its
+absolute ms is the per-device chunk-compute time in isolation, not the
+in-situ time inside the fused program (which overlaps the collective).
+Skew RATIOS are what it attributes; that is the straggler signal.  The
+cost is one additional gram per sampled chunk, which is why it rides
+``profile.on`` + a sampling stride, never ambient.
+
+``shard.skew.fault.device`` / ``shard.skew.fault.ms`` inject a synthetic
+straggler AFTER measurement (publish-side, the ``stream.fault.*``
+discipline) so the flag → journal → CLI path is testable on a host mesh
+where every virtual device runs the same silicon.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import List, Optional
+
+
+@functools.lru_cache(maxsize=32)
+def skew_probe_step(mesh, num_bins: int, num_classes: int,
+                    data_axis: str = "data", interpret: bool = False,
+                    block_cols=None):
+    """The per-device timing probe: each device runs the SAME local gram
+    pass as ``sharded_scan_step`` (identical kernel + shapes, so its wall
+    is representative) reduced to one scalar per device, with NO
+    cross-device collective and the [D] output sharded over the data
+    axis — shard *d* is ready exactly when device *d* is done.  Memoized
+    like the fused step, so repeated folds reuse the compiled probe."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from avenir_tpu.ops import pallas_hist
+    from avenir_tpu.parallel.collectives import _shard_map_norep
+
+    def step(codes, labels):
+        g = pallas_hist.cooc_counts.__wrapped__(
+            codes, labels, num_bins, num_classes, interpret=interpret,
+            block_cols=block_cols)
+        # int32 checksum: the value is discarded, only readiness is read
+        return jnp.sum(g, dtype=jnp.int32).reshape(1)
+
+    wrapped = _shard_map_norep(step, mesh,
+                               (P(data_axis, None), P(data_axis)),
+                               P(data_axis))
+    return jax.jit(wrapped)
+
+
+def publish_skew(device_ms: List[float], chunk: int, threshold: float,
+                 device_labels: List[str], counters=None,
+                 fault_device: int = -1, fault_ms: float = 0.0) -> dict:
+    """Publish one probe's per-device distribution: gauge + counters +
+    the golden-schema'd ``shard.skew`` journal event (``flagged`` when
+    max/min exceeds ``threshold``).  Factored out of the probe so the
+    fault-injection knobs and the golden-schema test exercise the REAL
+    emission path without a mesh."""
+    from avenir_tpu.telemetry import spans as tel
+
+    device_ms = [float(ms) for ms in device_ms]
+    if fault_ms > 0 and 0 <= fault_device < len(device_ms):
+        # synthetic straggler (test/bench knob): injected after the real
+        # measurement so the publish/flag path is attestable on a host
+        # mesh of identical virtual devices
+        device_ms[fault_device] += float(fault_ms)
+    floor = 1e-6
+    mx = max(device_ms)
+    mn = max(min(device_ms), floor)
+    ratio = mx / mn
+    slowest = int(device_ms.index(mx))
+    flagged = ratio > threshold
+    if counters is not None:
+        counters.set("Shard", "skew.pct", int(round(ratio * 100)))
+        if flagged:
+            counters.increment("Shard", "skew.flagged")
+    tracer = tel.tracer()
+    tracer.gauge("shard.skew.ratio", round(ratio, 4))
+    tracer.event(
+        "shard.skew", chunk=int(chunk),
+        device_ms=[round(ms, 3) for ms in device_ms],
+        max_ms=round(mx, 3), min_ms=round(min(device_ms), 3),
+        ratio=round(ratio, 4), threshold=float(threshold),
+        slowest=(device_labels[slowest]
+                 if slowest < len(device_labels) else str(slowest)),
+        flagged=bool(flagged))
+    return {"device_ms": device_ms, "ratio": ratio, "slowest": slowest,
+            "flagged": flagged}
+
+
+class DeviceSkewProbe:
+    """Sampled per-device wall probe around the sharded SharedScan fold.
+
+    Constructed by ``ChunkFolder`` only when a shard topology is active
+    AND ``profile.on`` is set (the off state never builds the probe or
+    its compiled program).  ``maybe_probe`` runs every
+    ``shard.skew.sample``-th call."""
+
+    def __init__(self, spec, num_bins: int, num_classes: int,
+                 interpret: bool = False, counters=None):
+        self.spec = spec
+        self.counters = counters
+        self.threshold = float(spec.skew_threshold)
+        self.sample_every = max(int(spec.skew_sample), 1)
+        self.step = skew_probe_step(spec.mesh, num_bins, num_classes,
+                                    data_axis=spec.data_axis,
+                                    interpret=interpret)
+        self._n = 0
+
+    def maybe_probe(self, codes, labels) -> Optional[dict]:
+        """Probe this chunk when its index lands on the sampling stride;
+        returns the published skew record or None.  ``codes``/``labels``
+        are the ALREADY-STAGED sharded operands of the fused dispatch —
+        each device times its own rows, the real per-device load."""
+        n = self._n
+        self._n += 1
+        if n % self.sample_every:
+            return None
+        out = self.step(codes, labels)
+        t0 = time.perf_counter()
+        shards = list(out.addressable_shards)
+        # label each timing with the shard's OWN device — never assume
+        # addressable_shards order matches the mesh's device order
+        labels_now = [
+            f"{getattr(sh.device, 'platform', 'dev')}:"
+            f"{getattr(sh.device, 'id', i)}"
+            for i, sh in enumerate(shards)]
+        times = [0.0] * len(shards)
+
+        def wait(i: int, data) -> None:
+            # block_until_ready releases the GIL: each thread observes
+            # ITS device's completion independently — sequential blocking
+            # would mask any straggler ordered before a fast device
+            data.block_until_ready()
+            times[i] = (time.perf_counter() - t0) * 1e3
+
+        threads = [threading.Thread(target=wait, args=(i, sh.data),
+                                    daemon=True)
+                   for i, sh in enumerate(shards)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return publish_skew(times, chunk=n, threshold=self.threshold,
+                            device_labels=labels_now,
+                            counters=self.counters,
+                            fault_device=self.spec.skew_fault_device,
+                            fault_ms=self.spec.skew_fault_ms)

@@ -340,6 +340,14 @@ class ChunkFolder:
             gbytes = (cells + 4 * rows if shard.quantized else 4 * cells)
             self._collective_bytes = gbytes + 4 * c * (
                 2 + 2 * meta.num_cont if self.needs_moments else 1)
+        # GraftFleet straggler attribution (round 15): a sampled
+        # per-device wall probe around the fused dispatch, built lazily
+        # on the first profiled fold — off (profile.on unset) the fold
+        # pays one attribute check and the probe program never compiles
+        self._skew = None
+        from avenir_tpu.telemetry import profile as _profile
+
+        self._prof = _profile.profiler()
 
     def cost_probe(self, ds: EncodedDataset):
         """(lowerable, args) for this folder's per-chunk device program —
@@ -391,6 +399,20 @@ class ChunkFolder:
                 self.counters.increment("Shard", "chunks")
                 self.counters.increment("Shard", "collective.bytes",
                                         self._collective_bytes)
+            if self._prof.enabled:
+                # per-device skew probe (after the host accumulation has
+                # drained the device — the probe times each chip's chunk
+                # work in isolation); stream panes inherit it through
+                # this same fold, zero stream-side code
+                if self._skew is None:
+                    from avenir_tpu.parallel.skew import DeviceSkewProbe
+
+                    self._skew = DeviceSkewProbe(
+                        self.shard, self.b, self.c,
+                        interpret=not pallas_hist.mesh_on_tpu(
+                            self.shard.mesh),
+                        counters=self.counters)
+                self._skew.maybe_probe(codes, labels)
             return
         acc.add("class", agg.class_counts(labels, self.c))
         moments_done = False

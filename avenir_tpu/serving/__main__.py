@@ -44,18 +44,28 @@ def main(argv: List[str]) -> int:
 
     conf = JobConfig.from_file(args.conf)
     # wire GraftTrace/GraftProf from the same properties file the models
-    # load from (trace.on / profile.on — both default off)
+    # load from (trace.on / profile.on — both default off); a replica
+    # pool sets trace.writer.suffix per worker, which names this
+    # process's journal shard AND its /metrics `replica` label
     from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry.export import fleet_identity
+    from avenir_tpu.telemetry.slo import SloEvaluator
 
     tel.configure(conf)
     registry = ModelRegistry.from_conf(conf)
     batcher = BucketedMicrobatcher.from_conf(registry, conf)
     port = (args.http_port if args.http_port is not None
             else conf.get_int("serve.http.port", 8390))
-    http = ScoreHTTPServer(batcher, port=port).start()
+    slo = SloEvaluator.from_conf(conf)
+    http = ScoreHTTPServer(
+        batcher, port=port, slo=slo,
+        identity=fleet_identity(
+            replica=conf.get("trace.writer.suffix"))).start()
     print(f"serving {registry.names()} on "
           f"http://{http.address[0]}:{http.address[1]} "
-          f"(buckets {batcher.buckets})", flush=True)
+          f"(buckets {batcher.buckets})"
+          + (f" with {len(slo.rules)} SLO rule(s)" if slo else ""),
+          flush=True)
 
     request_queue = conf.get("serve.request.queue")
     if request_queue:
@@ -70,13 +80,29 @@ def main(argv: List[str]) -> int:
                          name="serve-resp").start()
         print(f"RESP transport polling {request_queue!r}", flush=True)
 
+    # SIGTERM is how an orchestrator stops a replica (the GraftFleet
+    # deployment shape): without a handler the default action kills the
+    # process mid-write and skips the shutdown snapshot below — treat it
+    # exactly like Ctrl-C
+    import signal
+
+    stop = threading.Event()
     try:
-        threading.Event().wait()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except ValueError:                       # pragma: no cover - non-main
+        pass
+    try:
+        stop.wait()
     except KeyboardInterrupt:
         pass
     finally:
         http.stop()
         batcher.close()
+        # final counter snapshot into this replica's journal shard (no-op
+        # untraced): the post-hoc SLO gate's counter metrics (shed.rate,
+        # recompiles.total) and `telemetry metrics` need a snapshot — the
+        # serving loop otherwise journals only spans and gauges
+        tel.tracer().counters("serving", batcher.counters)
         print(json.dumps(batcher.stats()), flush=True)
     return 0
 

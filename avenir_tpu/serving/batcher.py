@@ -114,6 +114,14 @@ class BucketedMicrobatcher:
             for name in registry.names()}
         self._cond = threading.Condition()
         self._stop = False
+        # readiness (GraftFleet round 15): the /healthz probe's contract —
+        # a load balancer must not route to a replica whose (model,
+        # bucket) shapes are not compiled yet, or the first requests pay
+        # the compile on the hot path.  False until warm() completes; a
+        # deployment that disables serve.warmup.on.start stays NOT ready
+        # until it calls warm() itself (scoring is never gated — only the
+        # readiness signal).
+        self.ready = False
         if warmup:
             self.warm()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -137,10 +145,12 @@ class BucketedMicrobatcher:
     # -- warmup / recompile accounting ---------------------------------------
     def warm(self) -> Dict[str, int]:
         """Compile every (model, bucket) shape; shapes seen here never count
-        as recompiles later."""
+        as recompiles later.  Completing marks the batcher ready (the
+        /healthz readiness contract)."""
         warmed = self.registry.warmup(self.buckets)
         for name, entry in self.registry.items():
             self._monitors[name].prime(entry.compile_keys)
+        self.ready = True
         return warmed
 
     # -- hot swap (any thread) -----------------------------------------------
@@ -338,8 +348,12 @@ class BucketedMicrobatcher:
             tracer.gauge(f"serve.queue.{model}", len(self._queues[model]))
 
     # -- observability / shutdown --------------------------------------------
-    def stats(self) -> Dict[str, dict]:
-        return serving_stats(self.counters, self.latency)
+    def stats(self, identity: Optional[Dict[str, str]] = None
+              ) -> Dict[str, dict]:
+        """Per-model serving stats; ``identity`` (process/replica — the
+        frontend's scrape identity) rides into every row so N workers'
+        stats stay distinguishable after fleet aggregation."""
+        return serving_stats(self.counters, self.latency, identity=identity)
 
     def queue_depths(self) -> Dict[str, int]:
         """Per-model pending-queue depth — the ``/metrics`` gauges."""

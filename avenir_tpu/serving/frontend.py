@@ -56,9 +56,21 @@ class ScoreHTTPServer:
     """
 
     def __init__(self, batcher: BucketedMicrobatcher,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 slo=None, identity=None):
+        from avenir_tpu.telemetry import spans as _tel
+        from avenir_tpu.telemetry.export import fleet_identity
+
         self.batcher = batcher
         self.started = time.monotonic()
+        # GraftFleet (round 15): the scrape identity (process/replica
+        # labels on every /metrics sample and /stats row) and an optional
+        # SLO evaluator (telemetry/slo.py) rendering avenir_slo_burn_rate
+        # gauges per scrape.  Default identity reuses the tracer's writer
+        # suffix so scrape labels and journal shard names agree.
+        self.identity = identity if identity is not None else fleet_identity(
+            replica=_tel.tracer().writer_suffix or None)
+        self.slo = slo
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -88,27 +100,57 @@ class ScoreHTTPServer:
                     from avenir_tpu.telemetry import profile as _profile
                     from avenir_tpu.telemetry.export import prometheus_text
 
+                    depths = outer.batcher.queue_depths()
                     gauges = {f"serve.queue.{name}": float(depth)
-                              for name, depth
-                              in outer.batcher.queue_depths().items()}
+                              for name, depth in depths.items()}
                     gauges["uptime.sec"] = time.monotonic() - outer.started
+                    body = prometheus_text(
+                        counters=outer.batcher.counters,
+                        latency=outer.batcher.latency,
+                        gauges=gauges,
+                        device_bytes=_profile.profiler().gauges(),
+                        labels=outer.identity)
+                    if outer.slo is not None:
+                        # scrape-time SLO evaluation: burn-rate gauges on
+                        # the same page, slo.violation journaled on each
+                        # rule's transition into violation
+                        rows = outer.slo.evaluate_live(
+                            outer.batcher.counters, outer.batcher.latency,
+                            depths, gauges=gauges)
+                        slo_lines = []
+                        outer.slo.render_prometheus(rows, slo_lines,
+                                                    labels=outer.identity)
+                        body += "\n".join(slo_lines) + "\n"
                     self._send_text(
-                        200,
-                        prometheus_text(
-                            counters=outer.batcher.counters,
-                            latency=outer.batcher.latency,
-                            gauges=gauges,
-                            device_bytes=_profile.profiler().gauges()),
+                        200, body,
                         "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path == "/healthz":
-                    self._send(200, {
-                        "status": "ok",
-                        "models": outer.batcher.registry.names(),
+                    # readiness probe (round 15): 503 until every model is
+                    # loaded AND its (model, bucket) shapes are warmed —
+                    # what a load balancer in front of a replica pool
+                    # needs before routing traffic here.  The body
+                    # reports queue depth vs cap and each model's
+                    # last-swap version, so the prober can also see
+                    # backpressure and rollout state at a glance.
+                    ready = bool(getattr(outer.batcher, "ready", True))
+                    registry = outer.batcher.registry
+                    depths = outer.batcher.queue_depths()
+                    self._send(200 if ready else 503, {
+                        "status": "ok" if ready else "unavailable",
+                        "ready": ready,
+                        "models": registry.names(),
                         "buckets": outer.batcher.buckets,
+                        "queue": {
+                            name: {"depth": depth,
+                                   "cap": outer.batcher.queue_depth}
+                            for name, depth in depths.items()},
+                        "versions": {name: registry.version(name)
+                                     for name in registry.names()},
                         "uptime_sec": round(
                             time.monotonic() - outer.started, 3)})
                 elif self.path == "/stats":
-                    self._send(200, outer.batcher.stats())
+                    self._send(200,
+                               outer.batcher.stats(identity=outer.identity))
                 else:
                     self._send(404, {"error": "NOT_FOUND",
                                      "message": self.path})

@@ -15,14 +15,31 @@ profiling/counters machinery):
   (AOT cost analysis per distinct compile key, per-program wall totals)
   and device-memory gauges, free until ``profile.on``;
 - ``sentinel``: the perf-regression gate over bench artifacts
-  (``telemetry regress``; bench.py embeds its verdict in-process).
+  (``telemetry regress``; bench.py embeds its verdict in-process);
+- ``slo``: GraftFleet (round 15) — declarative ``slo.<name>.*`` rules
+  evaluated live on ``/metrics`` (burn-rate gauges) and post-hoc as the
+  ``telemetry slo`` CI gate.
+
+GraftFleet (round 15) also federates the journal: every process of a
+multi-process run (and every ``trace.writer.suffix`` replica) writes
+its own stamped shard sharing one run/trace id, reassembled by
+``telemetry merge`` / :func:`merge_journals`.
 
 ``python -m avenir_tpu.telemetry <journal>`` renders a run's span tree;
-``profile`` / ``metrics`` / ``regress`` subcommands render the roofline
-table, the post-hoc Prometheus snapshot, and the regression verdict.
+``merge`` / ``skew`` / ``slo`` / ``profile`` / ``metrics`` / ``regress``
+subcommands render the fleet view, the straggler table, the SLO
+verdict, the roofline table, the post-hoc Prometheus snapshot, and the
+regression verdict.
 """
 
-from avenir_tpu.telemetry.journal import Journal, latest_journal, read_events
+from avenir_tpu.telemetry.journal import (
+    Journal,
+    find_shards,
+    latest_journal,
+    merge_journals,
+    merge_shards,
+    read_events,
+)
 from avenir_tpu.telemetry.profile import (
     CompiledProgramRegistry,
     Profiler,
@@ -34,6 +51,7 @@ from avenir_tpu.telemetry.spans import (
     Span,
     Tracer,
     configure,
+    fleet_run_id,
     tracer,
 )
 
@@ -46,7 +64,11 @@ __all__ = [
     "Span",
     "Tracer",
     "configure",
+    "find_shards",
+    "fleet_run_id",
     "latest_journal",
+    "merge_journals",
+    "merge_shards",
     "profiler",
     "read_events",
     "tracer",

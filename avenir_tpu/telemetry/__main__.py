@@ -1,4 +1,5 @@
-"""GraftTrace/GraftProf journal CLI — ``python -m avenir_tpu.telemetry``.
+"""GraftTrace/GraftProf/GraftFleet journal CLI —
+``python -m avenir_tpu.telemetry``.
 
 Subcommands (the bare ``<journal>`` form keeps rendering the span tree):
 
@@ -8,6 +9,22 @@ Subcommands (the bare ``<journal>`` form keeps rendering the span tree):
   flagged (``OPEN`` — the first place to look in a *wedged* run), counter
   deltas between successive snapshots of the same scope, and a one-line
   tally of the free events (checkpoints, recompiles, gauges, canaries).
+  A merged fleet view (≥ 2 writers) attributes every span to its writer
+  (``proc=…``/``replica=…``).
+- ``merge <dir>`` — GraftFleet federation (round 15): time-order one
+  run's per-process journal shards (``run-<id>.proc-<k>[-<sfx>].jsonl``)
+  into one fleet view, tolerating torn tails and shards missing from
+  crashed/preempted workers.  Writes ``fleet-<id>.jsonl`` (never matches
+  the ``run-*`` shard pattern, so re-merging cannot double-count) which
+  every other subcommand renders; ``--stdout`` streams the JSONL
+  instead, ``--run`` picks a run when the directory holds several.
+- ``skew <journal>`` — the straggler table: per-device chunk-time
+  distribution from ``shard.skew`` events (``parallel/skew.py``), the
+  slowest device highlighted and threshold-flagged probes counted.
+- ``slo <journal>`` — the SLO gate (``telemetry/slo.py``): evaluate
+  ``slo.<name>.*`` rules (``--conf`` properties file and/or inline
+  ``--rule NAME=METRIC<=TARGET``) over the journal; exits 0 clean / 1
+  violated — the CI verdict the serving soak harness closes on.
 - ``profile <journal>`` — the GraftProf roofline table: one row per
   compiled program (``program.compiled`` + cumulative ``program.profile``
   events) with dispatch counts, wall time, achieved FLOP/s and an MFU
@@ -28,20 +45,33 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
 from avenir_tpu.telemetry.journal import read_events
 
 
+def _writer_of(event: dict) -> str:
+    """The writer-identity tag an event's GraftFleet stamp encodes:
+    ``p<proc>[-<replica>]``, or '' for pre-fleet journals."""
+    if "proc" not in event:
+        return ""
+    tag = f"p{event.get('proc')}"
+    if event.get("replica"):
+        tag += f"-{event['replica']}"
+    return tag
+
+
 class SpanNode:
     def __init__(self, span_id: str, name: str, parent: Optional[str],
-                 attrs: dict, ts: float):
+                 attrs: dict, ts: float, writer: str = ""):
         self.span_id = span_id
         self.name = name
         self.parent = parent
         self.attrs = dict(attrs or {})
         self.ts = ts
+        self.writer = writer                    # GraftFleet attribution
         self.dur_ms: Optional[float] = None     # None = never closed
         self.status = "open"
         self.children: List["SpanNode"] = []
@@ -56,7 +86,8 @@ def build_traces(events: List[dict]) -> Dict[str, List[SpanNode]]:
         if ev == "span.open":
             node = SpanNode(event.get("span", "?"), event.get("name", "?"),
                             event.get("parent"), event.get("attrs", {}),
-                            event.get("at", event.get("ts", 0.0)))
+                            event.get("at", event.get("ts", 0.0)),
+                            writer=_writer_of(event))
             nodes[node.span_id] = node
             parent = nodes.get(node.parent) if node.parent else None
             if parent is not None:
@@ -87,12 +118,14 @@ _INTERESTING_ATTRS = ("job", "stages", "chunks", "rows", "bucket", "model")
 
 
 def _render_node(node: SpanNode, prefix: str, is_last: bool, hot: set,
-                 out: List[str]) -> None:
+                 out: List[str], show_writer: bool = False) -> None:
     connector = "" if not prefix and is_last is None else (
         "└─ " if is_last else "├─ ")
     dur = ("OPEN" if node.dur_ms is None else f"{node.dur_ms:.1f} ms")
     extra = " ".join(f"{k}={node.attrs[k]}" for k in _INTERESTING_ATTRS
                      if k in node.attrs)
+    if show_writer and node.writer:
+        extra = f"{node.writer}" + (f" {extra}" if extra else "")
     mark = "  ◀" if node.span_id in hot else ""
     bad = f"  [{node.status}]" if node.status not in ("ok", "open") else ""
     label = f"{prefix}{connector}{node.name}"
@@ -103,33 +136,46 @@ def _render_node(node: SpanNode, prefix: str, is_last: bool, hot: set,
                              ("   " if is_last else "│  "))
     for i, child in enumerate(node.children):
         _render_node(child, child_prefix, i == len(node.children) - 1,
-                     hot, out)
+                     hot, out, show_writer=show_writer)
 
 
 def counter_deltas(events: List[dict]) -> List[str]:
     """Per-scope deltas between successive counter snapshots (the first
-    snapshot of a scope reads as a delta from zero)."""
-    prev: Dict[str, Dict[str, Dict[str, int]]] = {}
+    snapshot of a scope reads as a delta from zero).  Scopes are keyed
+    per WRITER in a merged fleet view — two processes' snapshots of the
+    same scope are distinct series, not one interleaved one — with the
+    ``@writer`` tag shown only when the view actually holds more than
+    one writer (a plain single-process journal keeps the round-10
+    rendering)."""
+    writers = {_writer_of(e) for e in events if e.get("ev") == "counters"}
+    tag_writers = len(writers) > 1
+    prev: Dict[tuple, Dict[str, Dict[str, int]]] = {}
     out: List[str] = []
     for event in events:
         if event.get("ev") != "counters":
             continue
+        writer = _writer_of(event)
         scope = event.get("scope", "?")
+        label = f"{scope}@{writer}" if writer and tag_writers else scope
         groups = event.get("groups", {})
-        before = prev.get(scope, {})
+        before = prev.get((scope, writer), {})
         for group in sorted(groups):
             for name in sorted(groups[group]):
                 delta = groups[group][name] - before.get(group, {}).get(
                     name, 0)
                 if delta:
-                    out.append(f"  [{scope}] {group}::{name} +{delta}")
-        prev[scope] = groups
+                    out.append(f"  [{label}] {group}::{name} +{delta}")
+        prev[(scope, writer)] = groups
     return out
 
 
 def render(events: List[dict], trace_filter: Optional[str] = None
            ) -> List[str]:
     traces = build_traces(events)
+    # writer attribution only when the view actually federates ≥2
+    # writers — a single-process journal keeps its round-10 rendering
+    writers = {_writer_of(e) for e in events if e.get("ev") == "span.open"}
+    show_writer = len(writers) > 1
     out: List[str] = []
     for trace_id, roots in traces.items():
         if trace_filter and trace_id != trace_filter:
@@ -138,7 +184,8 @@ def render(events: List[dict], trace_filter: Optional[str] = None
             total = ("OPEN" if root.dur_ms is None
                      else f"{root.dur_ms:.1f} ms")
             out.append(f"trace {trace_id}  ({root.name}, {total})")
-            _render_node(root, "", None, slowest_path(root), out)
+            _render_node(root, "", None, slowest_path(root), out,
+                         show_writer=show_writer)
             out.append("")
     deltas = counter_deltas(events)
     if deltas:
@@ -240,6 +287,159 @@ def render_profile(events: List[dict],
     return out
 
 
+# ---------------------------------------------------------------------------
+# GraftFleet renderers (round 15)
+# ---------------------------------------------------------------------------
+
+def render_skew(events: List[dict]) -> List[str]:
+    """The straggler table from ``shard.skew`` events: per-device
+    chunk-time distribution (count/mean/p50/max ms), the slowest device
+    highlighted (``◀``), and threshold-flagged probes tallied — the
+    post-hoc half of ``parallel/skew.py``."""
+    probes = [e for e in events if e.get("ev") == "shard.skew"
+              and isinstance(e.get("device_ms"), list)]
+    if not probes:
+        return ["journal carries no shard.skew events (profile.on unset, "
+                "no shard.* topology, or the run predates GraftFleet)"]
+    per_device: Dict[int, List[float]] = {}
+    flag_count: Dict[int, int] = {}
+    labels: Dict[int, str] = {}
+    flagged_probes = 0
+    threshold = probes[-1].get("threshold")
+    for e in probes:
+        ms = [float(v) for v in e["device_ms"]]
+        for d, v in enumerate(ms):
+            per_device.setdefault(d, []).append(v)
+        if e.get("flagged"):
+            flagged_probes += 1
+            slow = ms.index(max(ms))
+            flag_count[slow] = flag_count.get(slow, 0) + 1
+            labels.setdefault(slow, str(e.get("slowest", slow)))
+
+    # the ONE percentile definition (utils/metrics via slo's numpy-free
+    # fallback) — not a third private median in the same package
+    from avenir_tpu.telemetry.slo import _percentile
+
+    def p50(vals: List[float]) -> float:
+        return _percentile(vals, 50.0)
+
+    means = {d: sum(v) / len(v) for d, v in per_device.items()}
+    slowest_dev = max(means, key=lambda d: means[d])
+    out = [f"{'device':<14} {'probes':>7} {'mean ms':>9} {'p50 ms':>9} "
+           f"{'max ms':>9} {'flags':>6}"]
+    for d in sorted(per_device):
+        vals = per_device[d]
+        mark = "  ◀ slowest" if d == slowest_dev else ""
+        out.append(f"{labels.get(d, f'dev:{d}'):<14} {len(vals):>7} "
+                   f"{means[d]:>9.3f} {p50(vals):>9.3f} {max(vals):>9.3f} "
+                   f"{flag_count.get(d, 0):>6}{mark}")
+    out.append(f"probes: {len(probes)} · flagged: {flagged_probes}"
+               + (f" (threshold max/min > {threshold:g})"
+                  if isinstance(threshold, (int, float)) else ""))
+    out.append("times are sampled probe dispatches of the per-device gram "
+               "(parallel/skew.py) — skew RATIOS attribute stragglers; "
+               "absolute ms excludes collective overlap")
+    return out
+
+
+def merge_cli(rest: List[str]) -> int:
+    """``merge <dir>`` — reassemble one run's journal shards into a
+    fleet view file (or stdout)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m avenir_tpu.telemetry merge",
+        description="Merge a run's per-process journal shards into one "
+                    "time-ordered fleet view")
+    ap.add_argument("directory", help="directory holding run-*.jsonl shards")
+    ap.add_argument("--run", default=None,
+                    help="run id to merge (default: most recently written)")
+    ap.add_argument("--out", default=None,
+                    help="output path (default <dir>/fleet-<run>.jsonl)")
+    ap.add_argument("--stdout", action="store_true",
+                    help="stream merged JSONL to stdout instead of a file")
+    args = ap.parse_args(rest)
+    from avenir_tpu.telemetry.journal import merge_journals
+
+    run_id, shards, events = merge_journals(args.directory, run_id=args.run)
+    if run_id is None:
+        print(f"no run-*.jsonl journal shards under {args.directory!r}"
+              + (f" for run {args.run!r}" if args.run else ""),
+              file=sys.stderr)
+        return 2
+    lines = [json.dumps(e, separators=(",", ":")) for e in events]
+    if args.stdout:
+        for line in lines:
+            print(line)
+        return 0
+    out_path = args.out or os.path.join(args.directory,
+                                        f"fleet-{run_id}.jsonl")
+    with open(out_path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + ("\n" if lines else ""))
+    writers = sorted({w for w in (_writer_of(e) for e in events) if w})
+    print(f"run {run_id}: merged {len(shards)} shard(s), "
+          f"{len(events)} events"
+          + (f", writers {', '.join(writers)}" if writers else "")
+          + f" -> {out_path}")
+    return 0
+
+
+def slo_cli(rest: List[str]) -> int:
+    """``slo <journal>`` — the post-hoc SLO gate; exits 0 clean /
+    1 violated / 2 usage."""
+    from avenir_tpu.telemetry import slo as slo_mod
+
+    ap = argparse.ArgumentParser(
+        prog="python -m avenir_tpu.telemetry slo",
+        description="Evaluate slo.<name>.* rules over a run journal "
+                    "(exit 0 clean, 1 violated)")
+    ap.add_argument("journal", help="run-*.jsonl or merged fleet view")
+    ap.add_argument("--conf", default=None,
+                    help="properties file carrying slo.<name>.* rules")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="NAME=METRIC<=TARGET",
+                    help="inline rule (repeatable; >= for lower bounds)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the full summary as JSON")
+    args = ap.parse_args(rest)
+    rules = []
+    if args.conf:
+        from avenir_tpu.core.config import ConfigError, JobConfig
+
+        try:
+            rules.extend(slo_mod.rules_from_conf(
+                JobConfig.from_file(args.conf)))
+        except (OSError, ConfigError) as exc:
+            print(f"cannot load SLO rules: {exc}", file=sys.stderr)
+            return 2
+    for spec in args.rule:
+        try:
+            rules.append(slo_mod.parse_rule_spec(spec))
+        except ValueError as exc:
+            print(str(exc), file=sys.stderr)
+            return 2
+    if not rules:
+        print("no SLO rules: pass --conf <properties> and/or "
+              "--rule NAME=METRIC<=TARGET", file=sys.stderr)
+        return 2
+    try:
+        events = read_events(args.journal)
+    except OSError as exc:
+        print(f"cannot read journal: {exc}", file=sys.stderr)
+        return 2
+    summary = slo_mod.evaluate_events(events, rules)
+    if args.as_json:
+        print(json.dumps(summary))
+    else:
+        print(f"{args.journal}: {summary['verdict'].upper()}")
+        for row in summary["rules"]:
+            burn = ("-" if row["burn_rate"] is None
+                    else f"{row['burn_rate']:.3f}")
+            bound = "<=" if row["op"] == "max" else ">="
+            print(f"  {row['verdict']:>9}  {row['slo']:<16} "
+                  f"{row['metric']:<24} {row['value']} {bound} "
+                  f"{row['target']:g}  burn {burn}")
+    return 1 if summary["verdict"] == "violation" else 0
+
+
 class _Groups:
     """Duck-typed Counters stand-in (``as_dict`` only) so the stdlib CLI
     can reuse export.render_counters without importing numpy."""
@@ -287,7 +487,8 @@ def render_metrics(events: List[dict]) -> str:
 
 def main(argv: List[str]) -> int:
     # subcommand dispatch with the legacy bare-journal form preserved
-    commands = ("tree", "profile", "metrics", "regress")
+    commands = ("tree", "profile", "metrics", "regress", "merge", "skew",
+                "slo")
     if argv and argv[0] in commands:
         cmd, rest = argv[0], argv[1:]
     else:
@@ -296,6 +497,10 @@ def main(argv: List[str]) -> int:
         from avenir_tpu.telemetry.sentinel import cli as regress_cli
 
         return regress_cli(rest)
+    if cmd == "merge":
+        return merge_cli(rest)
+    if cmd == "slo":
+        return slo_cli(rest)
 
     ap = argparse.ArgumentParser(
         prog=f"python -m avenir_tpu.telemetry {cmd}".rstrip(),
@@ -324,6 +529,10 @@ def main(argv: List[str]) -> int:
             return 0
         if cmd == "metrics":
             print(render_metrics(events), end="")
+            return 0
+        if cmd == "skew":
+            for line in render_skew(events):
+                print(line)
             return 0
         if args.as_json:
             print(json.dumps(events))

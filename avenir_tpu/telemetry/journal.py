@@ -20,6 +20,15 @@ this port lacked (ISSUE 5).  Three disciplines:
   partial final line; :func:`read_events` skips it (and any other
   undecodable line) so every event that was fully written stays
   readable.
+
+GraftFleet (round 15) adds the SHARD layer on top: a multi-process (or
+replica-pool) run writes one journal shard per writer —
+``run-<id>.proc-<k>[-<suffix>].jsonl``, every event stamped with the
+writer identity (``stamp``) — and :func:`merge_shards` /
+:func:`find_shards` reassemble a run's shards into one time-ordered
+fleet view, tolerating torn tails and shards missing entirely (a
+crashed or preempted worker's shard simply ends early; its open spans
+render as ``OPEN``).
 """
 
 from __future__ import annotations
@@ -28,7 +37,7 @@ import json
 import os
 import threading
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from avenir_tpu.utils.locking import FileLock
 
@@ -42,11 +51,16 @@ class Journal:
     """
 
     def __init__(self, path: str, max_bytes: int = 64 << 20,
-                 lock_timeout_s: float = 0.0):
+                 lock_timeout_s: float = 0.0,
+                 stamp: Optional[Dict[str, object]] = None):
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
         self.path = path
         self.max_bytes = max(int(max_bytes), 1 << 12)
+        # writer-identity stamp merged into EVERY record (GraftFleet):
+        # proc/host/replica, so a merged fleet view attributes each event
+        # to the process that wrote it without parsing shard filenames
+        self.stamp = dict(stamp or {})
         self._mutex = threading.Lock()
         # held for the journal's lifetime: a concurrent writer raises
         # LockHeldError here instead of silently interleaving lines
@@ -59,6 +73,7 @@ class Journal:
         here.  Non-serializable field values degrade to ``repr`` rather
         than losing the event."""
         record: Dict[str, object] = {"ev": ev, "ts": round(time.time(), 6)}
+        record.update(self.stamp)
         record.update(fields)
         try:
             line = json.dumps(record, separators=(",", ":"))
@@ -138,3 +153,66 @@ def latest_journal(directory: str) -> Optional[str]:
         return None
     return os.path.join(directory, max(
         names, key=lambda n: os.path.getmtime(os.path.join(directory, n))))
+
+
+# ---------------------------------------------------------------------------
+# GraftFleet shard discovery + federation (round 15)
+# ---------------------------------------------------------------------------
+
+def shard_run_id(name: str) -> Optional[str]:
+    """The run id a shard filename encodes: ``run-<id>.jsonl`` (legacy
+    single-writer) or ``run-<id>.proc-<k>[-<suffix>].jsonl`` (fleet
+    shard); None for anything else (rotations, merged outputs)."""
+    if not name.startswith("run-") or not name.endswith(".jsonl"):
+        return None
+    body = name[len("run-"):-len(".jsonl")]
+    return body.split(".proc-", 1)[0] if body else None
+
+
+def find_shards(directory: str,
+                run_id: Optional[str] = None) -> Dict[str, List[str]]:
+    """run id → sorted shard paths under ``directory``.  Tolerates
+    missing shards trivially (a crashed/preempted worker's shard simply
+    is not there); ``run_id`` filters to one run."""
+    out: Dict[str, List[str]] = {}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        rid = shard_run_id(name)
+        if rid is None or (run_id is not None and rid != run_id):
+            continue
+        out.setdefault(rid, []).append(os.path.join(directory, name))
+    return out
+
+
+def merge_shards(paths: List[str]) -> List[dict]:
+    """One time-ordered fleet view from a run's shard files.
+
+    Reads every shard through :func:`read_events` (rotations included,
+    torn tails skipped) and stably sorts by the event's effective time
+    (``at`` when a retroactive event carries one, else ``ts``) — within
+    one timestamp, shard order then write order is preserved, so a
+    parent's ``span.open`` never sorts after its same-tick child from
+    the same shard."""
+    merged: List[dict] = []
+    for path in paths:
+        merged.extend(read_events(path))
+    merged.sort(key=lambda e: float(e.get("at", e.get("ts", 0.0)) or 0.0))
+    return merged
+
+
+def merge_journals(directory: str, run_id: Optional[str] = None
+                   ) -> Tuple[Optional[str], List[str], List[dict]]:
+    """(run id, shard paths, merged events) for one run under
+    ``directory``: the given ``run_id``, or the run whose newest shard
+    was most recently written."""
+    shards = find_shards(directory, run_id=run_id)
+    if not shards:
+        return None, [], []
+    if run_id is None:
+        run_id = max(shards, key=lambda rid: max(
+            os.path.getmtime(p) for p in shards[rid]))
+    paths = shards[run_id]
+    return run_id, paths, merge_shards(paths)

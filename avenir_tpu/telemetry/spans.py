@@ -24,8 +24,16 @@ instead of five unrelated artifacts.  Design constraints:
   close performs the host fetch through the existing
   ``profiling.device_sync`` discipline (``jax.block_until_ready`` is a
   no-op on some transports — BASELINE.md "Timing methodology").
-- **single-writer journal**: in multi-process runs only process 0 gets an
-  enabled tracer (``configure``), matching the part-file writer protocol.
+- **single-writer journal SHARDS** (GraftFleet, round 15): in
+  multi-process runs every process journals to its OWN shard
+  (``run-<id>.proc-<k>.jsonl``, each single-writer under its own
+  FileLock) instead of process 0 journaling and the workers dropping
+  their spans; serving replicas and fleet workers that are not
+  jax-distributed get the same treatment via ``trace.writer.suffix``.
+  Every event is stamped with ``proc``/``host`` (and ``replica`` when a
+  suffix is set), all shards share one conf-derived run id and root
+  trace id, and ``python -m avenir_tpu.telemetry merge <dir>``
+  time-orders the shards into one fleet view.
 
 :class:`CompileKeyMonitor` generalizes the serving batcher's compile-key
 diff (round 9) so *batch* chunk loops get the same measured ``recompiles``
@@ -143,19 +151,65 @@ class Tracer:
         self._seq = itertools.count(1)           # thread-safe in CPython
         self._lock = threading.Lock()
         self._once: set = set()                  # event_once keys, per journal
+        # GraftFleet identity (round 15): the journal stamp every event
+        # carries, the span-id prefix that keeps ids unique across a
+        # fleet's shards, and the shared root trace id that makes a
+        # multi-process run ONE trace in the merged view
+        self.stamp: dict = {}
+        self.process_index = 0
+        self.writer_suffix = ""
+        self._span_prefix = ""
+        self._root_trace: Optional[str] = None
 
     # -- lifecycle -----------------------------------------------------------
     def enable(self, journal_dir: Optional[str] = None,
-               max_bytes: int = 64 << 20) -> "Tracer":
+               max_bytes: int = 64 << 20, run_id: Optional[str] = None,
+               suffix: str = "") -> "Tracer":
         """Turn tracing on; with ``journal_dir``, open the run journal
-        ``run-<id>.jsonl`` there (single-writer, rotation-bounded)."""
+        there (single-writer, rotation-bounded).
+
+        Plain form (no ``run_id``/``suffix``, process 0): the legacy
+        ``run-<random>.jsonl`` single-process journal.  Fleet form — a
+        shared ``run_id`` (every process of a run must agree; ``configure``
+        derives it from the conf), a ``suffix`` naming a replica/worker
+        that is not jax-distributed, or a non-zero ``jax.process_index()``
+        — opens this writer's SHARD ``run-<id>.proc-<k>[-<suffix>].jsonl``,
+        stamps every event with ``proc``/``host``/``replica``, prefixes
+        span ids with the writer identity (ids stay unique across the
+        merged fleet view), and roots new traces at the run-derived trace
+        id so all shards share ONE trace."""
+        proc = 0
+        try:
+            import jax
+
+            proc = jax.process_index()
+        except Exception:                          # pragma: no cover
+            pass
+        import socket
+
         with self._lock:
             if self.enabled:
                 return self
+            self.process_index = proc
+            self.writer_suffix = suffix or ""
+            self.stamp = {"proc": proc, "host": socket.gethostname()}
+            if suffix:
+                self.stamp["replica"] = suffix
+            fleet = bool(run_id) or bool(suffix) or proc != 0
+            if fleet:
+                writer = f"proc-{proc}" + (f"-{suffix}" if suffix else "")
+                name = f"run-{run_id or _new_id('')}.{writer}.jsonl"
+                self._span_prefix = f"p{proc}" + \
+                    (f"-{suffix}" if suffix else "") + "."
+                self._root_trace = f"t{run_id}" if run_id else None
+            else:
+                name = f"run-{_new_id('')}.jsonl"
+                self._span_prefix = ""
+                self._root_trace = None
             if journal_dir:
-                path = os.path.join(journal_dir,
-                                    f"run-{_new_id('')}.jsonl")
-                self.journal = Journal(path, max_bytes=max_bytes)
+                self.journal = Journal(os.path.join(journal_dir, name),
+                                       max_bytes=max_bytes,
+                                       stamp=self.stamp)
             self._once.clear()                   # fresh journal, fresh onces
             self.enabled = True
         return self
@@ -173,6 +227,10 @@ class Tracer:
         with self._lock:
             self.enabled = False
             self._once.clear()
+            self._span_prefix = ""
+            self._root_trace = None
+            self.writer_suffix = ""
+            self.stamp = {}
             if self.journal is not None:
                 self.journal.close()
                 self.journal = None
@@ -202,8 +260,9 @@ class Tracer:
     def _live_span(self, name: str, attrs: Optional[Dict[str, Any]],
                    parent: Optional[Span]) -> Iterator[Span]:
         up = parent if parent is not None else _CURRENT.get()
-        trace_id = up.trace_id if up is not None else _new_id("t")
-        sp = Span(self, trace_id, f"s{next(self._seq)}",
+        trace_id = (up.trace_id if up is not None
+                    else self._root_trace or _new_id("t"))
+        sp = Span(self, trace_id, self._next_span_id(),
                   up.span_id if up is not None else None, name, attrs)
         token = _CURRENT.set(sp)
         self._journal_emit("span.open", trace=sp.trace_id, span=sp.span_id,
@@ -231,8 +290,9 @@ class Tracer:
         on a thread that never held the submitting context."""
         if not self.enabled:
             return
-        trace_id = parent.trace_id if parent is not None else _new_id("t")
-        span_id = f"s{next(self._seq)}"
+        trace_id = (parent.trace_id if parent is not None
+                    else self._root_trace or _new_id("t"))
+        span_id = self._next_span_id()
         ts = time.time()
         self._journal_emit("span.open", trace=trace_id, span=span_id,
                            parent=parent.span_id if parent else None,
@@ -240,6 +300,13 @@ class Tracer:
         self._journal_emit("span.close", trace=trace_id, span=span_id,
                            name=name, dur_ms=round(dur_s * 1e3, 3),
                            status=status, attrs=dict(attrs or {}), ts=ts)
+
+    def _next_span_id(self) -> str:
+        """Fleet-unique span id: the writer prefix (``p<k>[-<suffix>].``,
+        empty single-process) plus the process-local sequence — two
+        shards of one run can never collide on a span id in the merged
+        view."""
+        return f"{self._span_prefix}s{next(self._seq)}"
 
     # -- journal shorthands --------------------------------------------------
     def _journal_emit(self, ev: str, **fields) -> None:
@@ -296,14 +363,44 @@ def tracer() -> Tracer:
     return _TRACER
 
 
+def fleet_run_id(conf) -> str:
+    """The fleet-shared run identity every journal shard of one run
+    carries: ``trace.run.id`` when set, else a fingerprint of the conf's
+    workload properties.  Observability knobs (``trace.*``, ``profile.*``,
+    ``slo.*`` — including the per-replica ``trace.writer.suffix``) are
+    EXCLUDED: two replicas differing only in their writer suffix, or a
+    relaunch that turns profiling on, must land in the same run's shard
+    set.  Distinct from ``StreamCheckpointer.run_id_from_conf`` (which
+    keeps these keys — a checkpoint's identity is stricter than a
+    journal's)."""
+    explicit = conf.get("trace.run.id")
+    if explicit:
+        return explicit
+    import hashlib
+
+    drop = ("trace.", "profile.", "slo.", "telemetry.")
+    stable = sorted(
+        (k, v) for k, v in conf.props.items()
+        if not any((k[len(conf.prefix) + 1:] if k.startswith(
+            conf.prefix + ".") else k).startswith(p) for p in drop))
+    return hashlib.blake2s(repr(stable).encode(),
+                           digest_size=6).hexdigest()
+
+
 def configure(conf) -> Tracer:
     """Enable the process tracer from ``trace.*`` config keys; a no-op —
     and one dict lookup — when ``trace.on`` is unset.
 
-    Multi-process runs keep every process but 0 disabled: the journal is
-    single-writer (the part-file writer protocol), and spans with nowhere
-    to land would be pure overhead.  Idempotent: a pipeline and the jobs
-    it runs all call this with the same conf; the first enable wins.
+    GraftFleet (round 15): EVERY process of a multi-process run gets an
+    enabled tracer writing its own journal shard (previously workers'
+    spans were silently dropped by a process-0-only gate).  All shards of
+    one run share a conf-derived run id (``fleet_run_id``) and root trace
+    id, so ``telemetry merge`` + the span-tree CLI render the fleet as
+    ONE trace with per-process attribution.  Single-machine replica
+    pools and fleet workers that are not jax-distributed opt into the
+    same sharding with ``trace.writer.suffix`` (each writer suffix is a
+    distinct shard + ``replica`` stamp).  Idempotent: a pipeline and the
+    jobs it runs all call this with the same conf; the first enable wins.
 
     GraftProf (round 14) rides the same entry point: ``profile.on`` is
     checked here too, so every seam that configures tracing — driver,
@@ -315,16 +412,20 @@ def configure(conf) -> Tracer:
     t = _TRACER
     if not conf.get_bool("trace.on", False) or t.enabled:
         return t
+    nprocs = 1
     try:
         import jax
 
-        if jax.process_index() != 0:
-            return t
+        nprocs = jax.process_count()
     except Exception:                              # pragma: no cover
         pass
+    suffix = conf.get("trace.writer.suffix", "")
+    fleet = nprocs > 1 or bool(suffix) or bool(conf.get("trace.run.id"))
     max_mb = conf.get_float("telemetry.journal.max.mb", 64.0)
     t.enable(conf.get("trace.journal.dir") or ".",
-             max_bytes=int(max_mb * (1 << 20)))
+             max_bytes=int(max_mb * (1 << 20)),
+             run_id=fleet_run_id(conf) if fleet else None,
+             suffix=suffix)
     return t
 
 

@@ -162,7 +162,9 @@ class LatencyTracker:
 
 
 def serving_stats(counters: "Counters",
-                  latency: Dict[str, LatencyTracker]) -> Dict[str, dict]:
+                  latency: Dict[str, LatencyTracker],
+                  identity: Optional[Dict[str, str]] = None
+                  ) -> Dict[str, dict]:
     """The one stats schema both online paths publish: per served model,
     the ``Serving.<name>`` counter group merged with its latency
     percentiles.  Counter names inside the group: ``requests``, ``batches``,
@@ -174,7 +176,12 @@ def serving_stats(counters: "Counters",
     counter groups: a model that has counters but no tracker yet (e.g.
     registered and shedding before its first scored request, or a fleet
     rollup that only carried counters) reports with zeroed latency instead
-    of silently vanishing from the stats."""
+    of silently vanishing from the stats.
+
+    ``identity`` (GraftFleet round 15 —
+    ``telemetry.export.fleet_identity``: process index + replica suffix)
+    merges into every row, so stats federated from N workers of one
+    deployment never collide on identical model names."""
     groups = counters.as_dict()
     prefix = "Serving."
     names = set(latency) | {g[len(prefix):] for g in groups
@@ -185,6 +192,8 @@ def serving_stats(counters: "Counters",
         tracker = latency.get(name)
         stats.update(tracker.snapshot() if tracker is not None else
                      {"p50_ms": 0.0, "p99_ms": 0.0, "latency_samples": 0})
+        if identity:
+            stats.update(identity)
         out[name] = stats
     return out
 

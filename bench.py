@@ -303,6 +303,14 @@ def main():
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BASELINE.json"))
     line["regression"] = sentinel.bench_verdict(line, baseline_path)
+    # GraftFleet SLO gate (round 15): evaluate slo.<name>.* rules from
+    # the AVENIR_SLO_CONF properties file over this capture's own
+    # journal and embed the verdict next to the sentinel's — no rules
+    # configured → "no_rules", rules without a journal (AVENIR_TRACE_DIR
+    # unset) → "no_journal"; the capture publishes either way.
+    from avenir_tpu.telemetry import slo as slo_mod
+    line["slo"] = slo_mod.bench_verdict(tracer.journal_path,
+                                        os.environ.get("AVENIR_SLO_CONF"))
     prof.flush()             # cumulative program.profile into the journal
     print(json.dumps(line))
 

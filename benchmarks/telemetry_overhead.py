@@ -18,7 +18,15 @@ site (``profiler().sample``/``observe`` guard) while ``profile.on`` is
 unset: one attribute check and an early return, the same off-is-free
 contract the span sites hold.
 
-Pure host-side measurement: no accelerator work, runs anywhere.
+Round 15 (GraftFleet) re-measures the off-state bound with the fleet
+plane merged — the shard/stamp/skew/SLO machinery adds NOTHING to the
+off path (``span_ns_off`` is the same one-attribute-check site; the
+skew probe and SLO evaluator are gated behind the same
+``profiler().enabled`` check ``profile_site_ns_off`` measures, and no
+journal shard is ever created off) — and adds
+``span_ns_on_federated``: the on-state cost when the journal is a
+fleet SHARD (writer stamp on every event + prefixed span ids), so the
+per-event price of per-process attribution is a published number.
 """
 
 from __future__ import annotations
@@ -71,6 +79,15 @@ def measure() -> dict:
         journal_bytes = os.path.getsize(on.journal_path)
         on.disable()
 
+    # federated shard (GraftFleet): writer stamp on every event +
+    # prefixed span ids — the per-process-attribution price, on-state
+    fed = Tracer()
+    with tempfile.TemporaryDirectory() as tmp:
+        fed.enable(tmp, run_id="bench", suffix="w0")
+        fed_ns = measure_span_ns(fed)
+        fed_bytes = os.path.getsize(fed.journal_path)
+        fed.disable()
+
     # the nb_mi bench adds ~7 span sites per run (one bench span, five
     # pass spans, plus per-pass canary events); a pass is seconds of
     # device time, so project the off cost onto one 1-second pass
@@ -81,8 +98,11 @@ def measure() -> dict:
         "span_ns_off": round(off_ns, 1),
         "profile_site_ns_off": round(prof_off_ns, 1),
         "span_ns_on_journaled": round(on_ns, 1),
+        "span_ns_on_federated": round(fed_ns, 1),
         "journal_bytes_per_span": round(journal_bytes
                                         / (SPANS_PER_BATCH * BATCHES), 1),
+        "federated_bytes_per_span": round(fed_bytes
+                                          / (SPANS_PER_BATCH * BATCHES), 1),
         "bench_site_overhead_pct": round(overhead_pct, 6),
         "spans_per_batch": SPANS_PER_BATCH,
         "batches": BATCHES,

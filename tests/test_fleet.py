@@ -1,0 +1,696 @@
+"""GraftFleet (round 15) — journal federation, straggler/skew
+attribution, and the SLO evaluator.
+
+The heart is the federation acceptance contract: every process/replica
+of a run journals to its OWN shard (``run-<id>.proc-<k>[-<sfx>].jsonl``,
+stamped events, shared root trace id), ``telemetry merge`` reassembles
+one time-ordered fleet view — tolerating torn tails and killed workers —
+and the span-tree CLI renders it as ONE trace with per-writer
+attribution (pinned end-to-end by a fresh-subprocess gate that spawns
+two workers and kills one mid-span).  Around it: the per-device skew
+probe (fault-injected straggler → flagged ``shard.skew`` event →
+``telemetry skew`` table), SLO rules evaluated post-hoc (``telemetry
+slo`` exit codes) and live (burn-rate gauges on ``/metrics``, the
+violation latch), the ``/healthz`` readiness probe, and the
+process/replica scrape labels.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import ConfigError, JobConfig
+from avenir_tpu.telemetry import spans as tel
+from avenir_tpu.telemetry.journal import (Journal, find_shards,
+                                          merge_journals, merge_shards,
+                                          read_events, shard_run_id)
+from avenir_tpu.telemetry.__main__ import main as tel_main
+from avenir_tpu.utils.metrics import Counters, LatencyTracker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tel.tracer().disable()
+    yield
+    tel.tracer().disable()
+
+
+# ---------------------------------------------------------------------------
+# journal shards: naming, stamps, shared trace id
+# ---------------------------------------------------------------------------
+
+def test_enable_fleet_shard_naming_and_stamp(tmp_path):
+    t = tel.Tracer().enable(str(tmp_path), run_id="r42", suffix="w1")
+    assert t.journal_path.endswith("run-r42.proc-0-w1.jsonl")
+    with t.span("root") as root:
+        assert root.trace_id == "tr42"          # run-derived, fleet-shared
+        assert root.span_id.startswith("p0-w1.s")
+        with t.span("child") as child:
+            assert child.trace_id == "tr42"
+    path = t.journal_path
+    t.disable()
+    events = read_events(path)
+    assert events, "shard carries no events"
+    for e in events:
+        assert e["proc"] == 0 and e["replica"] == "w1" and e["host"]
+    assert {e["trace"] for e in events} == {"tr42"}
+
+
+def test_plain_enable_keeps_legacy_single_writer_form(tmp_path):
+    t = tel.Tracer().enable(str(tmp_path))
+    name = os.path.basename(t.journal_path)
+    assert name.startswith("run-") and ".proc-" not in name
+    with t.span("root") as root:
+        assert root.span_id == "s1"             # no writer prefix
+        assert root.trace_id != "t"             # random per-root trace
+    path = t.journal_path
+    t.disable()
+    # stamp still present (uniform schema), replica absent without suffix
+    for e in read_events(path):
+        assert "proc" in e and "host" in e and "replica" not in e
+
+
+def test_configure_writer_suffix_opts_into_federation(tmp_path):
+    conf = JobConfig({"trace.on": "true",
+                      "trace.journal.dir": str(tmp_path),
+                      "trace.writer.suffix": "replica3"})
+    tracer = tel.configure(conf)
+    assert tracer.enabled
+    assert ".proc-0-replica3.jsonl" in tracer.journal_path
+    rid = shard_run_id(os.path.basename(tracer.journal_path))
+    # the conf-derived run id: observability knobs excluded, so two
+    # replicas differing only in suffix land in the SAME run
+    other = dict(conf.props, **{"trace.writer.suffix": "replica4",
+                                "profile.on": "true"})
+    assert tel.fleet_run_id(JobConfig(other)) == rid
+    # a different WORKLOAD is a different run
+    assert tel.fleet_run_id(JobConfig({**other, "stream.chunk.rows": "9"})) \
+        != rid
+
+
+def test_merge_time_orders_attributes_and_tolerates_torn_tail(tmp_path,
+                                                              capsys):
+    d = str(tmp_path)
+    # two writers of one run, built directly at the Journal layer: the
+    # coordinator opens the root, the worker parent-links into the same
+    # trace (the configure() path does this via the shared run id)
+    j0 = Journal(os.path.join(d, "run-rx.proc-0.jsonl"),
+                 stamp={"proc": 0, "host": "h"})
+    j1 = Journal(os.path.join(d, "run-rx.proc-1.jsonl"),
+                 stamp={"proc": 1, "host": "h"})
+    j0.emit("span.open", trace="trx", span="p0.s1", parent=None,
+            name="pipeline.run", attrs={})
+    j1.emit("span.open", trace="trx", span="p1.s1", parent=None,
+            name="job.worker", attrs={})
+    j1.emit("span.close", trace="trx", span="p1.s1", name="job.worker",
+            dur_ms=5.0, status="ok", attrs={})
+    j0.emit("span.close", trace="trx", span="p0.s1", name="pipeline.run",
+            dur_ms=9.0, status="ok", attrs={})
+    j0.close()
+    j1.close()
+    with open(os.path.join(d, "run-rx.proc-1.jsonl"), "a") as fh:
+        fh.write('{"ev": "torn", "proc": 1, "fiel')      # crash mid-write
+    shards = find_shards(d)
+    assert set(shards) == {"rx"} and len(shards["rx"]) == 2
+    merged = merge_shards(shards["rx"])
+    assert [e["ev"] for e in merged].count("span.open") == 2
+    assert all(e["ev"] != "torn" for e in merged)        # torn tail skipped
+    ts = [e["ts"] for e in merged]
+    assert ts == sorted(ts)                              # time-ordered
+    # CLI merge → fleet file the tree renderer attributes per writer
+    assert tel_main(["merge", d]) == 0
+    out = capsys.readouterr().out
+    assert "merged 2 shard(s)" in out
+    fleet = os.path.join(d, "fleet-rx.jsonl")
+    assert os.path.exists(fleet)
+    # a fleet file never matches the shard pattern: re-merge is stable
+    assert shard_run_id("fleet-rx.jsonl") is None
+    assert tel_main([fleet]) == 0
+    tree = capsys.readouterr().out
+    assert tree.count("trace trx") == 2                  # two roots, ONE id
+    assert "p0" in tree and "p1" in tree                 # writer attribution
+
+
+def test_merge_cli_empty_dir_exits_2(tmp_path, capsys):
+    assert tel_main(["merge", str(tmp_path)]) == 2
+
+
+def test_fleet_subprocess_gate_kill_one_worker(tmp_path, capsys):
+    """The federation acceptance: 2 real processes, one killed mid-span;
+    the merged view holds both shards' events, ONE trace id, and an OPEN
+    span from the killed worker."""
+    d = str(tmp_path / "tel")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    worker = os.path.join(REPO, "tests", "fleet_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, d, "fleetrun", sfx, mode,
+             str(tmp_path / f"w-{sfx}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for sfx, mode in (("w0", "ok"), ("w1", "crash"))]
+    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    assert procs[0].returncode == 0, outs[0]
+    assert "fleet worker ok" in outs[0]
+    assert procs[1].returncode == 3, outs[1]             # died as injected
+
+    run_id, shards, merged = merge_journals(d, run_id="fleetrun")
+    assert run_id == "fleetrun" and len(shards) == 2
+    writers = {(e.get("proc"), e.get("replica"))
+               for e in merged if "proc" in e}
+    assert writers == {(0, "w0"), (0, "w1")}             # both shards merged
+    assert {e["trace"] for e in merged if "trace" in e} == {"tfleetrun"}
+    opens = {e["span"] for e in merged if e["ev"] == "span.open"}
+    closes = {e["span"] for e in merged if e["ev"] == "span.close"}
+    never_closed = opens - closes
+    assert any(s.startswith("p0-w1.") for s in never_closed), \
+        "killed worker left no OPEN span"
+    # real work in every shard: job spans + a per-process counter snapshot
+    names = {}
+    for e in merged:
+        if e["ev"] == "span.open":
+            names.setdefault(e.get("replica"), set()).add(e["name"])
+    assert "job.BayesianDistribution" in names["w0"]
+    assert "job.BayesianDistribution" in names["w1"]
+    snap_writers = {e.get("replica") for e in merged
+                    if e["ev"] == "counters"}
+    assert "w0" in snap_writers
+    # the tree CLI renders the merged view: one trace, OPEN flagged,
+    # per-writer attribution
+    assert tel_main(["merge", d, "--run", "fleetrun"]) == 0
+    fleet = os.path.join(d, "fleet-fleetrun.jsonl")
+    assert tel_main([fleet]) == 0
+    tree = capsys.readouterr().out
+    assert "OPEN" in tree and "p0-w0" in tree and "p0-w1" in tree
+
+
+# ---------------------------------------------------------------------------
+# straggler/skew attribution
+# ---------------------------------------------------------------------------
+
+def test_publish_skew_threshold_gauge_and_event(tmp_path):
+    from avenir_tpu.parallel.skew import publish_skew
+
+    tracer = tel.tracer().enable(str(tmp_path))
+    counters = Counters()
+    rec = publish_skew([10.0, 12.0], chunk=0, threshold=1.5,
+                       device_labels=["d0", "d1"], counters=counters)
+    assert not rec["flagged"]
+    assert counters.get("Shard", "skew.flagged") == 0
+    rec = publish_skew([10.0, 12.0], chunk=1, threshold=1.5,
+                       device_labels=["d0", "d1"], counters=counters,
+                       fault_device=1, fault_ms=100.0)
+    assert rec["flagged"] and rec["slowest"] == 1
+    assert counters.get("Shard", "skew.flagged") == 1
+    assert counters.get("Shard", "skew.pct") == round(112.0 / 10.0 * 100)
+    path = tracer.journal_path
+    tel.tracer().disable()
+    events = read_events(path)
+    skews = [e for e in events if e["ev"] == "shard.skew"]
+    assert [e["flagged"] for e in skews] == [False, True]
+    assert skews[1]["device_ms"] == [10.0, 112.0]
+    assert skews[1]["slowest"] == "d1"
+    assert any(e["ev"] == "gauge" and e["name"] == "shard.skew.ratio"
+               for e in events)
+
+
+def test_skew_probe_flags_injected_straggler_e2e(tmp_path, capsys):
+    """Sharded SharedScan under profile.on: the per-device probe runs,
+    the fault-injected straggler is flagged via a shard.skew event, and
+    `telemetry skew` renders the per-device table with the straggler
+    highlighted — while results stay byte-identical to the unsharded
+    fold."""
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.pipeline import scan
+    from avenir_tpu.telemetry import profile as prof_mod
+
+    n, f, b, c = 400, 3, 4, 2
+    rng = np.random.default_rng(1)
+    ds = EncodedDataset(
+        codes=rng.integers(0, b, (n, f)).astype(np.int32),
+        cont=np.zeros((n, 0), np.float32),
+        labels=rng.integers(0, c, n).astype(np.int32),
+        n_bins=np.full(f, b, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(f)), cont_ordinals=[])
+
+    def run(spec):
+        eng = scan.SharedScan(shard=spec, counters=Counters())
+        eng.register(scan.NaiveBayesConsumer(name="nb"))
+        out = eng.run(iter([ds.slice(0, 200), ds.slice(200, 400)]))
+        return out, eng.counters
+
+    base, _ = run(None)
+    tracer = tel.tracer().enable(str(tmp_path))
+    prof_mod.profiler().enable()
+    spec = ShardSpec.from_conf(JobConfig({
+        "shard.devices": "2", "shard.skew.sample": "1",
+        "shard.skew.threshold": "1.5",
+        "shard.skew.fault.device": "1", "shard.skew.fault.ms": "60000"}))
+    assert spec.skew_fault_ms == 60000.0
+    sharded, counters = run(spec)
+    path = tracer.journal_path
+    tel.tracer().disable()
+
+    np.testing.assert_array_equal(sharded["nb"].bin_counts,
+                                  base["nb"].bin_counts)
+    events = read_events(path)
+    skews = [e for e in events if e["ev"] == "shard.skew"]
+    assert len(skews) == 2                       # sample stride 1, 2 chunks
+    for e in skews:
+        assert len(e["device_ms"]) == 2
+        assert e["flagged"] and e["slowest"] == "cpu:1"
+    assert counters.get("Shard", "skew.flagged") == 2
+    assert counters.get("Shard", "skew.pct") > 150
+    assert tel_main(["skew", path]) == 0
+    table = capsys.readouterr().out
+    assert "◀ slowest" in table and "cpu:1" in table
+    assert "flagged: 2" in table
+
+
+def test_skew_probe_never_runs_with_profiling_off(tmp_path):
+    """Off-state contract: no profile.on → no probe, no events, no
+    compiled probe program (the fold pays one attribute check)."""
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.pipeline import scan
+
+    n, f, b, c = 128, 3, 4, 2
+    rng = np.random.default_rng(2)
+    ds = EncodedDataset(
+        codes=rng.integers(0, b, (n, f)).astype(np.int32),
+        cont=np.zeros((n, 0), np.float32),
+        labels=rng.integers(0, c, n).astype(np.int32),
+        n_bins=np.full(f, b, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(f)), cont_ordinals=[])
+    tracer = tel.tracer().enable(str(tmp_path))
+    spec = ShardSpec.from_conf(JobConfig({"shard.devices": "2"}))
+    eng = scan.SharedScan(shard=spec)
+    eng.register(scan.NaiveBayesConsumer(name="nb"))
+    eng.run(iter([ds]))
+    path = tracer.journal_path
+    tel.tracer().disable()
+    assert not any(e["ev"] == "shard.skew" for e in read_events(path))
+
+
+def test_skew_cli_without_events(tmp_path, capsys):
+    with Journal(str(tmp_path / "run-x.jsonl")) as journal:
+        journal.emit("gauge", name="q", value=1)
+    assert tel_main(["skew", str(tmp_path / "run-x.jsonl")]) == 0
+    assert "no shard.skew events" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluator
+# ---------------------------------------------------------------------------
+
+def test_slo_rules_from_conf_parsing():
+    from avenir_tpu.telemetry.slo import rules_from_conf
+
+    conf = JobConfig({
+        "slo.p99.metric": "p99.latency.ms",
+        "slo.p99.target": "50",
+        "slo.p99.window.sec": "300",
+        # namespaced spelling must parse identically (avenir.x == x)
+        "avenir.slo.shed.metric": "shed.rate",
+        "avenir.slo.shed.target": "0.01",
+        "slo.floor.metric": "counter:Records:Processed",
+        "slo.floor.target": "100",
+        "slo.floor.op": "min",
+        "slo.window.sec": "600",
+    })
+    rules = {r.name: r for r in rules_from_conf(conf)}
+    assert set(rules) == {"p99", "shed", "floor"}
+    assert rules["p99"].window_sec == 300.0
+    assert rules["shed"].window_sec == 600.0      # the global default
+    assert rules["floor"].op == "min"
+    with pytest.raises(ConfigError):
+        rules_from_conf(JobConfig({"slo.x.metric": "shed.rate"}))  # no target
+    with pytest.raises(ConfigError):
+        rules_from_conf(JobConfig({"slo.x.metric": "shed.rate",
+                                   "slo.x.target": "1",
+                                   "slo.x.op": "between"}))
+
+
+def _serving_events(durs_ms, shed=0, requests=10, recompiles=0, depth=0,
+                    ts=1000.0):
+    events = [{"ev": "span.close", "ts": ts + i * 0.001,
+               "name": "serve.request", "dur_ms": d, "span": f"s{i}"}
+              for i, d in enumerate(durs_ms)]
+    events.append({"ev": "counters", "ts": ts + 1, "scope": "serve",
+                   "groups": {"Serving.m": {"requests": requests,
+                                            "shed": shed,
+                                            "recompiles": recompiles}}})
+    events.append({"ev": "gauge", "ts": ts + 1,
+                   "name": "serve.queue.m", "value": depth})
+    return events
+
+
+def test_slo_evaluate_events_pass_violation_and_window():
+    from avenir_tpu.telemetry.slo import SloRule, evaluate_events
+
+    events = _serving_events([5.0] * 20, shed=1, requests=99, depth=3)
+    rules = [SloRule("p99", "p99.latency.ms", 50.0),
+             SloRule("shed", "shed.rate", 0.05),
+             SloRule("queue", "queue.depth", 10),
+             SloRule("rc", "recompiles.total", 0.0)]
+    summary = evaluate_events(events, rules)
+    assert summary["verdict"] == "pass"
+    assert all(r["verdict"] == "pass" for r in summary["rules"])
+
+    bad = _serving_events([5.0] * 10 + [900.0], shed=50, requests=50,
+                          recompiles=2, depth=2048)
+    summary = evaluate_events(bad, rules)
+    assert summary["verdict"] == "violation"
+    verdicts = {r["slo"]: r["verdict"] for r in summary["rules"]}
+    assert verdicts == {"p99": "violation", "shed": "violation",
+                        "queue": "violation", "rc": "violation"}
+    burn = {r["slo"]: r["burn_rate"] for r in summary["rules"]}
+    assert burn["queue"] == pytest.approx(2048 / 10)
+    assert burn["rc"] == pytest.approx(1e9)       # zero-target violation
+
+    # trailing window: ancient slow requests age out of a windowed p99
+    old = [{"ev": "span.close", "ts": 100.0, "name": "serve.request",
+            "dur_ms": 900.0, "span": "old"}]
+    windowed = [SloRule("p99", "p99.latency.ms", 50.0, window_sec=60.0)]
+    recent = _serving_events([5.0] * 5, ts=1000.0)
+    assert evaluate_events(old + recent, windowed)["verdict"] == "pass"
+    assert evaluate_events(old + recent,
+                           [SloRule("p99", "p99.latency.ms", 50.0)]
+                           )["verdict"] == "violation"
+
+    # a rule whose metric has no data reports no_data, never fails
+    summary = evaluate_events([], rules)
+    assert summary["verdict"] == "no_data"
+
+
+def test_slo_counter_metrics_last_snapshot_per_writer():
+    """A single traced pipeline journals the same totals under several
+    scopes (per-stage, per-job, the `pipeline` rollup); counter SLO
+    metrics must read ONE writer's LAST snapshot — never sum scopes —
+    or a clean run fails its own gate 2-3x inflated (review finding).
+    Distinct writers of a merged fleet view still add."""
+    from avenir_tpu.telemetry.slo import SloRule, evaluate_events
+
+    one_writer = [
+        {"ev": "counters", "ts": 1.0, "proc": 0, "host": "h",
+         "scope": "stage1",
+         "groups": {"Records": {"Processed": 100},
+                    "Telemetry": {"recompiles": 1}}},
+        {"ev": "counters", "ts": 2.0, "proc": 0, "host": "h",
+         "scope": "job.X",
+         "groups": {"Records": {"Processed": 100},
+                    "Telemetry": {"recompiles": 1}}},
+        {"ev": "counters", "ts": 3.0, "proc": 0, "host": "h",
+         "scope": "pipeline",
+         "groups": {"Records": {"Processed": 100},
+                    "Telemetry": {"recompiles": 1}}},
+    ]
+    rules = [SloRule("floor", "counter:Records:Processed", 100, op="min"),
+             SloRule("ceil", "counter:Records:Processed", 100),
+             SloRule("rc", "recompiles.total", 1.0)]
+    summary = evaluate_events(one_writer, rules)
+    assert {r["slo"]: r["verdict"] for r in summary["rules"]} == {
+        "floor": "pass", "ceil": "pass", "rc": "pass"}
+    assert summary["rules"][0]["value"] == 100.0        # not 300
+    two_writers = one_writer + [
+        {"ev": "counters", "ts": 4.0, "proc": 1, "host": "h",
+         "scope": "pipeline", "groups": {"Records": {"Processed": 40}}}]
+    summary = evaluate_events(
+        two_writers, [SloRule("total", "counter:Records:Processed", 140,
+                              op="min")])
+    assert summary["rules"][0]["value"] == 140.0        # writers add
+
+
+def test_slo_live_gauge_queue_metric():
+    """The documented live form of gauge:<name> — the per-model queue
+    gauges — must evaluate on /metrics scrapes, not report no_data
+    (review finding)."""
+    from avenir_tpu.telemetry.slo import SloEvaluator, SloRule
+
+    ev = SloEvaluator([SloRule("q", "gauge:serve.queue.m", 10),
+                       SloRule("other", "gauge:uptime.sec", 10)])
+    rows = {r["slo"]: r for r in ev.evaluate_live(Counters(), {},
+                                                  {"m": 25, "n": 1})}
+    assert rows["q"]["verdict"] == "violation"
+    assert rows["q"]["value"] == 25.0
+    assert rows["other"]["verdict"] == "no_data"        # no gauges map given
+    # with the scrape's gauge page (the frontend form) ANY gauge resolves
+    rows = {r["slo"]: r for r in SloEvaluator(
+        [SloRule("up", "gauge:uptime.sec", 10, op="min")]).evaluate_live(
+        Counters(), {}, {}, gauges={"uptime.sec": 42.0})}
+    assert rows["up"]["verdict"] == "pass"
+    assert rows["up"]["value"] == 42.0
+
+
+def test_bench_verdict_malformed_rules_never_raises(tmp_path):
+    """A malformed AVENIR_SLO_CONF must surface as a verdict, never
+    crash the capture after all its measurement (review finding:
+    ConfigError escaped the OSError guard)."""
+    from avenir_tpu.telemetry import slo as slo_mod
+
+    props = tmp_path / "bad.properties"
+    props.write_text("slo.p99.metric=p99.latency.ms\n")   # no target
+    summary = slo_mod.bench_verdict(None, str(props))
+    assert summary["verdict"] == "rules_error"
+    assert "target" in summary["error"]
+
+
+def test_job_snapshot_only_when_outermost(tmp_path):
+    """Job.run journals its counter snapshot only as the OUTERMOST
+    traced unit: inside a pipeline the driver owns the per-stage
+    snapshot, and a duplicate series would double counter deltas and
+    the SLO totals (review finding)."""
+    import json as _json
+
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+    from avenir_tpu.jobs import get_job
+
+    write_csv(str(tmp_path / "train.csv"), generate_churn(80, seed=5))
+    (tmp_path / "churn.json").write_text(
+        _json.dumps(CHURN_SCHEMA_JSON) if isinstance(CHURN_SCHEMA_JSON, dict)
+        else CHURN_SCHEMA_JSON)
+    conf = JobConfig({"feature.schema.file.path":
+                      str(tmp_path / "churn.json"),
+                      "trace.on": "true",
+                      "trace.journal.dir": str(tmp_path / "tel")})
+    tracer = tel.configure(conf)
+    # standalone: the job IS the outermost unit → one snapshot
+    get_job("BayesianDistribution").run(conf, str(tmp_path / "train.csv"),
+                                        str(tmp_path / "nb1"))
+    # nested under an enclosing span (the pipeline-stage shape): skipped
+    with tracer.span("stage.nb"):
+        get_job("BayesianDistribution").run(
+            conf, str(tmp_path / "train.csv"), str(tmp_path / "nb2"))
+    path = tracer.journal_path
+    tel.tracer().disable()
+    snaps = [e for e in read_events(path) if e["ev"] == "counters"]
+    assert [e["scope"] for e in snaps] == ["BayesianDistribution"]
+
+
+def test_slo_cli_exit_codes(tmp_path, capsys):
+    path = str(tmp_path / "run-slo.jsonl")
+    with Journal(path) as journal:
+        for i in range(20):
+            journal.emit("span.close", name="serve.request",
+                         dur_ms=5.0, span=f"s{i}")
+        journal.emit("counters", scope="serve",
+                     groups={"Serving.m": {"requests": 100, "shed": 0,
+                                           "recompiles": 0}})
+    assert tel_main(["slo", path, "--rule", "p99=p99.latency.ms<=50",
+                     "--rule", "rc=recompiles.total<=0"]) == 0
+    assert "PASS" in capsys.readouterr().out
+    assert tel_main(["slo", path, "--rule", "p99=p99.latency.ms<=1"]) == 1
+    assert "VIOLATION" in capsys.readouterr().out
+    assert tel_main(["slo", path]) == 2                  # no rules: usage
+    assert tel_main(["slo", path, "--rule", "garbage"]) == 2
+    # rules from a properties file (the soak-harness form)
+    props = tmp_path / "slo.properties"
+    props.write_text("slo.floor.metric=counter:Serving.m:requests\n"
+                     "slo.floor.target=99\nslo.floor.op=min\n")
+    capsys.readouterr()
+    assert tel_main(["slo", path, "--conf", str(props)]) == 0
+    assert tel_main(["slo", path, "--conf", str(props),
+                     "--rule", "shed=shed.rate<=0.5", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out.splitlines()[-1])[
+        "verdict"] == "pass"
+
+
+def test_slo_live_burn_rate_and_violation_latch(tmp_path):
+    from avenir_tpu.telemetry.slo import SloEvaluator, SloRule
+
+    tracer = tel.tracer().enable(str(tmp_path))
+    counters = Counters()
+    tracker = LatencyTracker()
+    ev = SloEvaluator([SloRule("p99", "p99.latency.ms", 50.0),
+                       SloRule("queue", "queue.depth", 8)])
+    for _ in range(10):
+        tracker.record(0.002)
+    rows = ev.evaluate_live(counters, {"m": tracker}, {"m": 2})
+    assert {r["slo"]: r["verdict"] for r in rows} == {"p99": "pass",
+                                                      "queue": "pass"}
+    # into violation: journaled ONCE, then latched
+    ev.evaluate_live(counters, {"m": tracker}, {"m": 99})
+    ev.evaluate_live(counters, {"m": tracker}, {"m": 99})
+    # recovery re-arms; the next excursion journals again
+    ev.evaluate_live(counters, {"m": tracker}, {"m": 1})
+    ev.evaluate_live(counters, {"m": tracker}, {"m": 77})
+    path = tracer.journal_path
+    tel.tracer().disable()
+    violations = [e for e in read_events(path) if e["ev"] == "slo.violation"]
+    assert [e["slo"] for e in violations] == ["queue", "queue"]
+    assert violations[0]["burn_rate"] == pytest.approx(99 / 8)
+
+    # prometheus rendering: burn-rate gauges with identity labels
+    lines = []
+    SloEvaluator.render_prometheus(rows, lines,
+                                   labels={"process": "0", "replica": "a"})
+    assert any(line.startswith(
+        'avenir_slo_burn_rate{process="0",replica="a",slo="p99"')
+        for line in lines)
+
+
+def test_bench_slo_verdict_shapes(tmp_path):
+    from avenir_tpu.telemetry import slo as slo_mod
+
+    assert slo_mod.bench_verdict(None, None)["verdict"] == "no_rules"
+    props = tmp_path / "slo.properties"
+    props.write_text("slo.rc.metric=recompiles.total\nslo.rc.target=0\n")
+    assert slo_mod.bench_verdict(None, str(props))["verdict"] == "no_journal"
+    path = str(tmp_path / "run-b.jsonl")
+    with Journal(path) as journal:
+        journal.emit("counters", scope="bench",
+                     groups={"Telemetry": {"recompiles": 1}})
+    summary = slo_mod.bench_verdict(path, str(props))
+    assert summary["verdict"] == "violation"
+    assert summary["rules"][0]["value"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# serving satellites: readiness, labels, /metrics SLO gauges
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def nb_ws(tmp_path_factory):
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+    from avenir_tpu.jobs import get_job
+
+    root = tmp_path_factory.mktemp("fleet_serving")
+    rows = generate_churn(200, seed=11)
+    write_csv(str(root / "train.csv"), rows[:160])
+    write_csv(str(root / "test.csv"), rows[160:])
+    (root / "churn.json").write_text(
+        json.dumps(CHURN_SCHEMA_JSON) if isinstance(CHURN_SCHEMA_JSON, dict)
+        else CHURN_SCHEMA_JSON)
+    conf = JobConfig({"feature.schema.file.path": str(root / "churn.json")})
+    get_job("BayesianDistribution").run(conf, str(root / "train.csv"),
+                                        str(root / "nb_model"))
+    return {"root": root,
+            "conf": {"feature.schema.file.path": str(root / "churn.json"),
+                     "serve.models": "naiveBayes",
+                     "bayesian.model.file.path": str(root / "nb_model"),
+                     "serve.bucket.sizes": "1,4"}}
+
+
+def test_healthz_readiness_probe(nb_ws):
+    from avenir_tpu.serving.batcher import BucketedMicrobatcher
+    from avenir_tpu.serving.frontend import ScoreHTTPServer
+    from avenir_tpu.serving.registry import ModelRegistry
+
+    conf = JobConfig({**nb_ws["conf"], "serve.warmup.on.start": "false"})
+    registry = ModelRegistry.from_conf(conf)
+    with BucketedMicrobatcher.from_conf(registry, conf) as batcher:
+        assert not batcher.ready
+        with ScoreHTTPServer(batcher) as srv:
+            host, port = srv.address
+            base = f"http://{host}:{port}"
+            # not warmed: a load balancer must not route here yet
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/healthz")
+            assert exc.value.code == 503
+            body = json.loads(exc.value.read())
+            assert body["ready"] is False
+            assert body["status"] == "unavailable"
+            batcher.warm()
+            with urllib.request.urlopen(f"{base}/healthz") as resp:
+                assert resp.status == 200
+                health = json.loads(resp.read())
+            assert health["ready"] is True and health["status"] == "ok"
+            assert health["models"] == ["naiveBayes"]
+            # queue depth vs cap + last-swap version: what the item-2
+            # replica pool's balancer actually needs
+            assert health["queue"]["naiveBayes"]["depth"] == 0
+            assert health["queue"]["naiveBayes"]["cap"] == \
+                batcher.queue_depth
+            assert health["versions"]["naiveBayes"] == 1
+
+
+def test_metrics_slo_gauges_and_identity_labels(nb_ws):
+    from avenir_tpu.serving.batcher import BucketedMicrobatcher
+    from avenir_tpu.serving.frontend import ScoreHTTPServer
+    from avenir_tpu.serving.registry import ModelRegistry
+    from avenir_tpu.telemetry.slo import SloEvaluator
+
+    conf = JobConfig({**nb_ws["conf"],
+                      "slo.queue.metric": "queue.depth",
+                      "slo.queue.target": "1000",
+                      "slo.rc.metric": "recompiles.total",
+                      "slo.rc.target": "0"})
+    registry = ModelRegistry.from_conf(conf)
+    with BucketedMicrobatcher.from_conf(registry, conf) as batcher, \
+            ScoreHTTPServer(batcher, slo=SloEvaluator.from_conf(conf),
+                            identity={"process": "0", "replica": "w7"}
+                            ) as srv:
+        host, port = srv.address
+        line = open(nb_ws["root"] / "test.csv").readline().strip()
+        batcher.submit("naiveBayes", line)
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics").read().decode()
+        stats = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/stats").read())
+    # every sample carries the writer identity (federated scrapes from N
+    # replicas never collide), and the SLO burn rates ride the same page
+    assert ('avenir_counter_total{process="0",replica="w7",'
+            'group="Serving.naiveBayes",name="requests"} 1') in body
+    assert ('avenir_slo_burn_rate{process="0",replica="w7",slo="queue",'
+            'metric="queue.depth"}') in body
+    assert ('avenir_slo_burn_rate{process="0",replica="w7",slo="rc",'
+            'metric="recompiles.total"} 0') in body
+    # /stats rows carry the same identity (serving_stats satellite)
+    assert stats["naiveBayes"]["replica"] == "w7"
+    assert stats["naiveBayes"]["process"] == "0"
+
+
+def test_prometheus_labels_unit_and_serving_stats_identity():
+    from avenir_tpu.telemetry.export import fleet_identity, prometheus_text
+    from avenir_tpu.utils.metrics import serving_stats
+
+    counters = Counters()
+    counters.increment("Records", "Processed", 7)
+    text = prometheus_text(counters=counters, gauges={"q": 2.0},
+                           labels={"process": "3", "replica": "b"})
+    assert ('avenir_counter_total{process="3",replica="b",group="Records",'
+            'name="Processed"} 7') in text
+    assert 'avenir_gauge{process="3",replica="b",name="q"} 2' in text
+    # unlabeled rendering unchanged (the post-hoc `telemetry metrics` CLI)
+    assert 'avenir_counter_total{group="Records"' in prometheus_text(
+        counters=counters)
+    ident = fleet_identity(replica="w1")
+    assert ident["process"] == "0" and ident["replica"] == "w1"
+    assert "replica" not in fleet_identity()
+
+    sc = Counters()
+    sc.increment("Serving.m", "requests", 4)
+    stats = serving_stats(sc, {}, identity={"process": "0", "replica": "z"})
+    assert stats["m"]["requests"] == 4 and stats["m"]["replica"] == "z"
+    # without identity the round-9 schema is untouched
+    assert "replica" not in serving_stats(sc, {})["m"]

@@ -287,8 +287,9 @@ def test_metrics_route_exposes_device_bytes(churn_ws, tmp_path):
         host, port = srv.address
         body = urllib.request.urlopen(
             f"http://{host}:{port}/metrics").read().decode()
-    assert ('avenir_device_bytes{device="faketpu:0",kind="bytes_in_use"} '
-            '777') in body
+    # live samples carry the GraftFleet writer-identity labels
+    assert ('avenir_device_bytes{process="0",device="faketpu:0",'
+            'kind="bytes_in_use"} 777') in body
     assert "# TYPE avenir_device_bytes gauge" in body
 
 

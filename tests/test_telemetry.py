@@ -178,14 +178,18 @@ def test_metrics_endpoint_prometheus_text(churn_ws, tmp_path):
         assert resp.headers["Content-Type"].startswith("text/plain")
         body = resp.read().decode()
     batcher.close()
-    # the SAME counters the batcher reports, in Prometheus text format
+    # the SAME counters the batcher reports, in Prometheus text format —
+    # every live sample carries the writer-identity labels (GraftFleet:
+    # federated scrapes from N workers must not collide on series names)
     served = batcher.counters.get("Serving.naiveBayes", "requests")
     assert served == len(rows)
-    assert (f'avenir_counter_total{{group="Serving.naiveBayes",'
+    assert (f'avenir_counter_total{{process="0",group="Serving.naiveBayes",'
             f'name="requests"}} {served}') in body
-    assert 'avenir_latency_seconds{model="naiveBayes",quantile="0.5"}' in body
-    assert 'avenir_latency_seconds_count{model="naiveBayes"}' in body
-    assert 'avenir_gauge{name="serve.queue.naiveBayes"} 0' in body
+    assert ('avenir_latency_seconds{process="0",model="naiveBayes",'
+            'quantile="0.5"}') in body
+    assert 'avenir_latency_seconds_count{process="0",model="naiveBayes"}' \
+        in body
+    assert 'avenir_gauge{process="0",name="serve.queue.naiveBayes"} 0' in body
     assert "# TYPE avenir_counter_total counter" in body
 
 
@@ -245,6 +249,18 @@ GOLDEN_EVENT_KEYS = {
     "recompile": {"ev", "ts", "trace", "span", "scope", "keys"},
     "checkpoint.save": {"ev", "ts", "trace", "span", "dir", "run", "rows",
                         "chunk"},
+    # GraftFleet (round 15): per-device straggler probes
+    # (parallel/skew.py — flagged when max/min exceeds the threshold),
+    # cross-process collective-wait attribution (parallel/mesh.py), and
+    # the SLO evaluator's transition-into-violation record
+    # (telemetry/slo.py) — docs/observability.md event table
+    "shard.skew": {"ev", "ts", "trace", "span", "chunk", "device_ms",
+                   "max_ms", "min_ms", "ratio", "threshold", "slowest",
+                   "flagged"},
+    "collective.wait": {"ev", "ts", "trace", "span", "site", "wall_ms",
+                        "bytes", "procs"},
+    "slo.violation": {"ev", "ts", "trace", "span", "slo", "metric",
+                      "value", "target", "burn_rate"},
     # the StreamGraft lifecycle (round 11): windowed drift scoring, the
     # sustained-drift firing, the retrain completion, and the serving
     # plane's hot swap — docs/observability.md event table
@@ -279,6 +295,12 @@ GOLDEN_EVENT_KEYS = {
                          "regressed", "skipped", "missing", "baseline"},
     "xla.trace": {"ev", "ts", "trace", "span", "stage", "dir"},
 }
+
+# GraftFleet (round 15): EVERY journaled event additionally carries the
+# writer-identity stamp — process index + host (and `replica` when a
+# writer suffix is set) — so a merged fleet view attributes each event
+# without parsing shard filenames
+STAMP_KEYS = {"proc", "host"}
 
 
 class _FakeDevice:
@@ -321,6 +343,25 @@ def test_golden_event_shapes(tmp_path):
                      family="naiveBayes", warmed=True)
         tracer.event("shard.topology", devices=8, device_kind="cpu",
                      mesh={"data": 8}, axes=["data"])
+        # GraftFleet events (round 15): the skew probe's publish path is
+        # the REAL emission seam (parallel/skew.py — fed fabricated
+        # per-device times, exactly what the fault-injection knob does);
+        # slo.violation rides the live evaluator's transition latch;
+        # collective.wait's producer needs a real multi-process gather
+        # (tests/test_multiprocess.py territory), so its shape is pinned
+        # via the same tracer.event form checkpoint.save uses
+        from avenir_tpu.parallel.skew import publish_skew
+        from avenir_tpu.telemetry.slo import SloEvaluator, SloRule
+
+        publish_skew([10.0, 41.0], chunk=3, threshold=1.5,
+                     device_labels=["cpu:0", "cpu:1"], counters=counters)
+        tracer.event("collective.wait", site="all_process_sum_state",
+                     wall_ms=12.5, bytes=4096, procs=2)
+        slo_counters = Counters()
+        slo_counters.increment("Serving.m", "requests", 10)
+        slo_counters.increment("Serving.m", "shed", 90)
+        SloEvaluator([SloRule("shed", "shed.rate", 0.05)]).evaluate_live(
+            slo_counters, {}, {})
         # GraftProf events ride the REAL emission paths
         from avenir_tpu.telemetry import profile as prof_mod
         from avenir_tpu.telemetry import sentinel
@@ -341,7 +382,8 @@ def test_golden_event_shapes(tmp_path):
         seen.setdefault(event["ev"], set(event))
     assert set(seen) == set(GOLDEN_EVENT_KEYS)
     for ev, keys in GOLDEN_EVENT_KEYS.items():
-        assert seen[ev] == keys, f"{ev} schema drifted: {seen[ev]} != {keys}"
+        want = keys | STAMP_KEYS
+        assert seen[ev] == want, f"{ev} schema drifted: {seen[ev]} != {want}"
     # root span.open: parent is present and null (roots are identifiable)
     root_open = next(e for e in read_events(path) if e["ev"] == "span.open")
     assert root_open["parent"] is None
