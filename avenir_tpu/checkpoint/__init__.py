@@ -1,0 +1,19 @@
+"""ElasticGraft — the elastic-restore plane (round 16).
+
+``checkpoint/reshard.py`` is the redistribution transform that makes
+checkpointed accumulator state layout-portable across topology change
+(kill on 8 devices, resume on 4, byte-identical); ``utils/checkpoint.py``
+remains the durable snapshot store it operates on.
+"""
+
+from avenir_tpu.checkpoint.reshard import (  # noqa: F401
+    MESH_TAG,
+    ReshardError,
+    journal_reshard,
+    rekey_state,
+    reshard_state_tree,
+    snapshot_suffix,
+    spec_suffix,
+    split_mesh_key,
+    state_suffix,
+)

@@ -1,0 +1,227 @@
+"""ElasticGraft reshard — topology-portable checkpoint redistribution.
+
+PR 7's mesh-qualified accumulator keys (``parallel/shard.py::
+ShardSpec.g_suffix``, ``:mesh:<axis><n>``) make a resharded restore fail
+LOUDLY — the right first step, but production TPU quota is preemptible,
+and a fleet that shrinks 8→4 devices must resume, not die.  This module
+is the redistribution transform (the portable collective array-
+redistribution recipe, arXiv 2112.01075, applied to *host* accumulator
+state): a saved state tree is re-keyed and redistributed for a new
+topology, exactly, or refused with a typed :class:`ReshardError` naming
+the offending key.
+
+Why re-keying is exact: every mesh-qualified entry is a 64-bit HOST
+total that the in-kernel psum already reduced over the source mesh —
+int64 count sums (and the order-exact float64 moment sums the tests
+construct) are mesh-shape-invariant, so an 8-way fold's totals ARE the
+4-way fold's totals byte-for-byte.  The mesh suffix exists to prevent
+*silent* cross-topology summing, not because the numbers differ; the
+transform moves state across that gate deliberately and journals the
+crossing (``checkpoint.reshard``).
+
+What stays refused (genuinely non-portable):
+
+- a ``g:`` key whose mesh suffix matches neither the declared source
+  topology nor the target (mixed/unknown-topology state);
+- two entries that would collide under one target key;
+- a ``g:`` key whose base LAYOUT differs from the target fold's (the
+  kernel plan is a pure function of (F, B, C) — a base mismatch means
+  the schema changed, which no redistribution can reconcile);
+- chunked-einsum count state (``fc``/``pcc<off>`` keys) restored onto a
+  gram-keyed routing: the pair-chunked tensors cannot be promoted back
+  into one G matrix (pairs outside the union were never aggregated).
+
+The routing-aware half — *demoting* a gram onto a target that folds
+under chunked einsum keys — lives with the owner of the routing,
+:meth:`avenir_tpu.pipeline.scan.ChunkFolder.adopt_state`; this module
+holds the generic key algebra so every seam (``WindowCheckpointer``,
+``StreamCheckpointer``, ``CheckpointManager.restore(reshard_to=...)``)
+transforms state the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+MESH_TAG = ":mesh:"
+
+
+class ReshardError(ValueError):
+    """State that cannot be redistributed to the target topology; the
+    message names the offending key."""
+
+
+def spec_suffix(spec) -> str:
+    """The mesh-qualifier suffix of a topology operand: a
+    ``ShardSpec``-like object (``g_suffix``), an explicit suffix string
+    (``":mesh:data4"`` or ``""``), or None (unsharded)."""
+    if spec is None:
+        return ""
+    if isinstance(spec, str):
+        if spec and not spec.startswith(MESH_TAG):
+            raise ReshardError(
+                f"target suffix {spec!r} is not a {MESH_TAG}<axis><n> "
+                f"mesh qualifier")
+        return spec
+    return spec.g_suffix
+
+
+def split_mesh_key(key: str) -> Tuple[str, str]:
+    """``"g:cls:f4:b5:c2:mesh:data8"`` → ``("g:cls:f4:b5:c2",
+    ":mesh:data8")``; an unqualified key keeps an empty suffix."""
+    pos = key.find(MESH_TAG)
+    if pos < 0:
+        return key, ""
+    return key[:pos], key[pos:]
+
+
+def state_suffix(state: Dict[str, Any]) -> Optional[str]:
+    """The ONE mesh suffix an accumulator-state mapping was folded under:
+    ``":mesh:<axis><n>"`` for a fused-shard fold, ``""`` for an
+    unqualified gram, None when the mapping holds no gram key at all (no
+    topology evidence — an empty pane, a moments-only fold).  Raises
+    :class:`ReshardError` on mixed-topology state — two suffixes in one
+    mapping means some totals would survive a re-key that others refuse,
+    which is exactly the silent-partial-fold hazard."""
+    seen: Dict[str, str] = {}
+    for key in state:
+        if isinstance(key, str) and key.startswith("g:"):
+            _, sfx = split_mesh_key(key)
+            seen[sfx] = key
+    if len(seen) > 1:
+        raise ReshardError(
+            f"mixed-topology accumulator state: gram keys "
+            f"{sorted(seen.values())} carry different mesh qualifiers — "
+            f"state folded under two topologies cannot be redistributed")
+    return next(iter(seen), None)
+
+
+def snapshot_suffix(state: Dict[str, Any]) -> Optional[str]:
+    """The writing topology of a WHOLE checkpoint snapshot: the recorded
+    ``"shard"`` field when present (round-16 snapshots), else inferred
+    from the gram keys of every accumulator mapping it holds
+    (``ring[i]["state"]`` pane states, ``"acc"`` totals) — panes with no
+    gram evidence (empty panes) don't vote.  None = no evidence anywhere;
+    :class:`ReshardError` when two panes disagree."""
+    recorded = state.get("shard")
+    if isinstance(recorded, str):
+        return recorded
+    votes = set()
+    for rec in state.get("ring") or []:
+        if isinstance(rec, dict):
+            sfx = state_suffix(rec.get("state") or {})
+            if sfx is not None:
+                votes.add(sfx)
+    if isinstance(state.get("acc"), dict):
+        sfx = state_suffix(state["acc"])
+        if sfx is not None:
+            votes.add(sfx)
+    if len(votes) > 1:
+        raise ReshardError(
+            f"snapshot holds accumulator state under {len(votes)} "
+            f"different topologies ({sorted(votes)}) — mixed-topology "
+            f"snapshots cannot be redistributed")
+    return next(iter(votes), None)
+
+
+def rekey_state(state: Dict[str, Any], target,
+                source=None) -> Tuple[Dict[str, Any], List[str]]:
+    """Re-key every mesh-qualified ``g:`` entry of one accumulator-state
+    mapping for the target topology; values pass through UNTOUCHED (the
+    64-bit totals are mesh-shape-invariant — see module docstring).
+
+    ``target``/``source`` are :func:`spec_suffix` operands; a None source
+    means "accept whatever one suffix the state carries" (inferred via
+    :func:`state_suffix`).  Returns ``(new_state, rekeyed_keys)``.
+    Raises :class:`ReshardError` on a suffix that matches neither source
+    nor target, or a post-transform collision.
+    """
+    dst = spec_suffix(target)
+    if source is not None:
+        src = spec_suffix(source)
+    else:
+        inferred = state_suffix(state)
+        src = dst if inferred is None else inferred
+    out: Dict[str, Any] = {}
+    rekeyed: List[str] = []
+    for key, val in state.items():
+        new_key = key
+        if isinstance(key, str) and key.startswith("g:"):
+            base, sfx = split_mesh_key(key)
+            if sfx not in (src, dst):
+                raise ReshardError(
+                    f"gram state {key!r} was folded under topology "
+                    f"{sfx or 'unsharded'!r}, not the declared source "
+                    f"{src or 'unsharded'!r} — refusing to redistribute "
+                    f"state of unknown provenance")
+            new_key = base + dst
+            if new_key != key:
+                rekeyed.append(key)
+        if new_key in out:
+            raise ReshardError(
+                f"redistributing {key!r} onto {new_key!r} collides with "
+                f"another entry of the same state — the source mapping "
+                f"already holds both topologies' totals")
+        out[new_key] = val
+    return out, rekeyed
+
+
+def _is_acc_state(node: Any) -> bool:
+    return isinstance(node, dict) and any(
+        isinstance(k, str) and k.startswith("g:") for k in node)
+
+
+def reshard_state_tree(tree: Any, target,
+                       source=None) -> Tuple[Any, List[str]]:
+    """Walk an arbitrary checkpoint state tree and re-key every
+    accumulator-state mapping (any dict holding a ``g:`` key) for the
+    target topology — the generic transform behind
+    ``CheckpointManager.restore(reshard_to=...)``.  Covers the shapes the
+    repo persists today: ``WindowCheckpointer`` pane rings (``ring[i]
+    ["state"]``), ``StreamCheckpointer`` totals (``"acc"``), and LR
+    history/gradient folds (no ``g:`` keys — pass through untouched, as
+    do cursors and pane/row counters, which count rows, not devices).
+    A top-level ``"shard"`` entry (the recorded writing topology) is
+    rewritten to the target suffix.  Returns ``(new_tree, rekeyed_keys)``.
+    """
+    rekeyed: List[str] = []
+
+    def walk(node: Any) -> Any:
+        if _is_acc_state(node):
+            out, moved = rekey_state(node, target, source)
+            rekeyed.extend(moved)
+            return out
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        if isinstance(node, tuple):
+            return tuple(walk(v) for v in node)
+        return node
+
+    out = walk(tree)
+    # only the TOP-LEVEL "shard" entry is the snapshot's recorded writing
+    # topology; nested dicts (component extras) may use the name freely
+    if isinstance(out, dict) and isinstance(out.get("shard"), str):
+        out["shard"] = spec_suffix(target)
+    return out, rekeyed
+
+
+def journal_reshard(src: str, dst: str, keys: int, directory: str = "",
+                    run: str = "") -> None:
+    """Journal one ``checkpoint.reshard`` crossing (golden-schema'd,
+    tests/test_telemetry.py): the topology a snapshot was written under,
+    the topology it was redistributed onto, and how many accumulator
+    entries moved — so GraftFleet's merged trace explains every
+    preemption drill end to end."""
+    from avenir_tpu.telemetry import spans as tel
+
+    tel.tracer().event("checkpoint.reshard",
+                       dir=directory, run=run,
+                       src=src or "unsharded", dst=dst or "unsharded",
+                       keys=keys)
+
+
+def describe(suffix: str) -> str:
+    """Human-readable topology name for error messages/logs."""
+    return suffix or "unsharded"

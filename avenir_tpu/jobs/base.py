@@ -774,7 +774,7 @@ class StreamCheckpointer:
     def __init__(self, directory: str, interval_chunks: int = 8,
                  resume: bool = False, crash_after_chunks: int = 0,
                  parent_dir: Optional[str] = None, run_id: str = "",
-                 defer_errors: bool = False):
+                 defer_errors: bool = False, reshard: bool = False):
         from avenir_tpu.ops import agg
         from avenir_tpu.utils.checkpoint import CheckpointManager
 
@@ -841,6 +841,44 @@ class StreamCheckpointer:
                             f"checkpoint; clear the directory and re-run")
                         state = None
                 if state is not None:
+                    # ElasticGraft (round 16): the standalone streaming
+                    # folds run unsharded, so a mesh-qualified snapshot
+                    # (written by a sharded seam sharing the directory)
+                    # is a topology crossing — redistribute under the
+                    # shard.reshard.on.restore gate, refuse loudly
+                    # otherwise, never fold silently.  Suffix-less
+                    # ROUTING crossings (kernel↔einsum key families) are
+                    # deliberately NOT gated here: the model fit paths
+                    # that consume this accumulator dictate their route
+                    # from the restored keys themselves — converting a
+                    # gram exactly or continuing on the einsum family —
+                    # and reject foreign layouts loudly
+                    # (models/mutual_info.py::fit resume gate)
+                    from avenir_tpu.checkpoint import reshard as _reshard
+
+                    try:
+                        snap_sfx = _reshard.snapshot_suffix(state)
+                    except _reshard.ReshardError as e:
+                        snap_sfx = None
+                        self.error = str(e)
+                        state = None
+                    if state is not None and snap_sfx:
+                        if reshard:
+                            state, moved = _reshard.reshard_state_tree(
+                                state, "")
+                            _reshard.journal_reshard(
+                                snap_sfx, "", len(moved),
+                                directory=self.directory, run=self.run_id)
+                        else:
+                            self.error = (
+                                f"snapshot in {directory!r} was folded "
+                                f"under mesh topology {snap_sfx!r} but "
+                                f"this job folds unsharded — set "
+                                f"shard.reshard.on.restore=true to "
+                                f"redistribute it, or clear the "
+                                f"directory and re-run")
+                            state = None
+                if state is not None:
                     self.accumulator.load(state["acc"])
                     self.base_rows = int(state["rows"])
                     self.start = {k: state["cursor"][k]
@@ -880,8 +918,23 @@ class StreamCheckpointer:
             return explicit
         import hashlib
 
+        # the topology/drill shard.* keys and fault.* joined the volatile
+        # set in round 16 (ElasticGraft): the mesh topology is execution
+        # LAYOUT, not semantics — results are proven byte-identical
+        # across it, the mesh-qualified g: keys + the snapshot's recorded
+        # "shard" suffix carry topology identity now, and the
+        # shard.reshard.on.restore gate governs crossing it.  Keeping
+        # shard.devices in the fingerprint would make every
+        # preempted-and-shrunk relaunch a "different run", unreachable by
+        # the elastic restore by construction; fault.*/shard.skew.* are
+        # relaunch scaffolding like stream.fault.*.  Deliberately NOT
+        # excluded: shard.allreduce.quantized — it changes NUMERICS (the
+        # lossy int8 collective), so a relaunch flipping it is a
+        # different run whose totals must never merge with exact ones
+        # (the same reason pipeline/scan.py lists it in _COMPAT_KEYS)
         volatile = ("stream.resume", "stream.fault.", "stream.checkpoint.",
-                    "stream.prefetch.")
+                    "stream.prefetch.", "shard.devices", "shard.data.axis",
+                    "shard.reshard.", "shard.skew.", "fault.")
         stable = sorted(
             (k, v) for k, v in conf.props.items()
             if not any(k == v0.rstrip(".") or k.startswith(v0)
@@ -921,7 +974,8 @@ class StreamCheckpointer:
                    conf.get_int("stream.fault.crash.after.chunks", 0),
                    parent_dir=parent,
                    run_id=cls.run_id_from_conf(conf),
-                   defer_errors=nprocs > 1)
+                   defer_errors=nprocs > 1,
+                   reshard=conf.get_bool("shard.reshard.on.restore", False))
         if nprocs > 1:
             ckpt._handshake_errors(pid)
         return ckpt

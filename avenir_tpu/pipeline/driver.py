@@ -183,10 +183,18 @@ class Pipeline:
         from avenir_tpu.telemetry import spans as tel
 
         tracer = tel.configure(self.conf)
-        with tracer.span("pipeline.run",
-                         attrs={"workspace": self.workspace,
-                                "stages": len(todo),
-                                "resume": bool(resume)}):
+        # ElasticGraft (round 16): resolve the elastic-restore policy once
+        # at run start — shard.reshard.on.restore=true lets the restore
+        # seams (WindowCheckpointer / StreamCheckpointer) redistribute a
+        # snapshot written under a different mesh topology onto this
+        # run's; default OFF keeps the loud refusal.  Resolved here so an
+        # unparsable value fails before any stage runs, and the journal's
+        # root span records the policy the run restored under.
+        run_attrs = {"workspace": self.workspace, "stages": len(todo),
+                     "resume": bool(resume)}
+        if self.conf.get_bool("shard.reshard.on.restore", False):
+            run_attrs["reshard.on.restore"] = True
+        with tracer.span("pipeline.run", attrs=run_attrs):
             # ShardGraft (round 12): resolve the shard.* topology once at
             # run start so an impossible request (more devices than
             # attached, multi-process) fails HERE, before any stage runs.

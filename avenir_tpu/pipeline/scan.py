@@ -452,6 +452,92 @@ class ChunkFolder:
             acc.add("cont_sum", s1)
             acc.add("cont_sumsq", s2)
 
+    @property
+    def g_suffix(self) -> str:
+        """The mesh qualifier this folder's gram key carries ("" off the
+        fused shard path) — what a pane snapshot records as its writing
+        topology and the elastic restore compares against."""
+        return self.shard.g_suffix if self.step == "shard" else ""
+
+    def state_matches_routing(self, state: Dict[str, Any]) -> bool:
+        """Does a persisted accumulator-state mapping use THIS folder's
+        key family?  False means folding it with fresh panes would mix
+        key families — the restore seam must adopt (or refuse) it first.
+        Catches more than a mesh-suffix comparison: a kernel↔einsum
+        ROUTING crossing at the same topology (a snapshot moved between
+        a TPU host and a CPU host) re-keys too, in BOTH directions —
+        gram state landing on the einsum routing, and einsum ``fc``
+        counts landing on a gram routing (where ``tables()``'s
+        gram-first read-out would silently ignore them) — and previously
+        slipped through to a silent partial fold."""
+        gram = [k for k in state
+                if isinstance(k, str) and k.startswith("g:")]
+        if self.step == "einsum":
+            return not gram
+        return "fc" not in state and all(k == self.gk for k in gram)
+
+    def adopt_state(self, state: Dict[str, Any]) -> Tuple[Dict[str, Any],
+                                                          List[str]]:
+        """Redistribute one persisted accumulator-state mapping onto THIS
+        folder's routing — the "refuse OR reshard, never silently fold"
+        half of the foreign-key discipline (``tables()`` keeps the
+        refusal; restore seams call this first, under the
+        ``shard.reshard.on.restore`` gate).  Returns ``(state,
+        rekeyed_keys)`` — unchanged state comes back as-is.
+
+        Exact by construction: 64-bit host totals are mesh-shape-
+        invariant, so re-keying ``:mesh:<axis><n>`` qualifiers moves the
+        SAME bytes under the new topology's key (checkpoint/reshard.py).
+        Demotion onto the chunked-einsum routing converts the gram
+        through ``counts_from_cooc`` — the identical read-out
+        ``tables()`` itself runs.  Genuinely non-portable state raises
+        :class:`~avenir_tpu.checkpoint.reshard.ReshardError`: a foreign
+        base LAYOUT (the schema changed), mixed-topology state, or
+        einsum-chunked counts promoted onto a gram routing (pairs outside
+        the persisted union were never aggregated)."""
+        from avenir_tpu.checkpoint import reshard
+        from avenir_tpu.ops import pallas_hist
+
+        reshard.state_suffix(state)         # refuse mixed-topology state
+        base_gk = pallas_hist.g_key(self.f, self.b, self.c)
+        gram_keys = [k for k in state
+                     if isinstance(k, str) and k.startswith("g:")]
+        for key in gram_keys:
+            base, _ = reshard.split_mesh_key(key)
+            if base != base_gk:
+                raise reshard.ReshardError(
+                    f"gram state {key!r} has base layout {base!r} but "
+                    f"this fold's is {base_gk!r} — the kernel layout "
+                    f"(schema shape F/B/C) changed; no redistribution "
+                    f"can reconcile different layouts")
+        if gram_keys and "fc" in state:
+            raise reshard.ReshardError(
+                f"state holds both gram {gram_keys[0]!r} and einsum 'fc' "
+                f"counts — mixed-routing state cannot be redistributed")
+        if self.step == "einsum":
+            if not gram_keys:
+                return state, []            # same chunked-einsum routing
+            # demote: one gram → the einsum family ("fc" + per-chunk
+            # "pcc<off>"), via the exact read-out tables() runs
+            (key,) = gram_keys              # bounded above: one topology
+            out = {k: v for k, v in state.items() if k != key}
+            fbc, pcc = pallas_hist.counts_from_cooc(
+                np.asarray(state[key]), self.f, self.b, self.c,
+                self.pair_index[:, 0], self.pair_index[:, 1])
+            out["fc"] = fbc
+            for s in range(0, len(self.pair_index), self.pair_chunk):
+                # keys mirror fold()'s gated family — graftlint: disable=GL002
+                out[f"pcc{s}"] = pcc[s:s + self.pair_chunk]
+            return out, [key]
+        if "fc" in state and not gram_keys:
+            raise reshard.ReshardError(
+                "state was folded under the chunked-einsum routing "
+                "('fc'/'pcc<off>' keys) but this fold reads the fused "
+                "gram — pair counts outside the persisted union were "
+                "never aggregated, so promotion is impossible; restore "
+                "on an einsum-routed topology or start clean")
+        return reshard.rekey_state(state, self.g_suffix)
+
     def tables(self, acc: agg.Accumulator, rows: int) -> ScanTables:
         """The shared per-stream totals from an accumulator this folder
         filled.  Tolerates an EMPTY accumulator (a window whose panes held
@@ -473,8 +559,10 @@ class ChunkFolder:
                     f"this fold reads {self.gk!r} — the kernel layout or "
                     f"mesh topology (shard.devices / shard.data.axis) "
                     f"changed since that state was written; a resharded "
-                    f"run must start from a clean accumulator, not fold "
-                    f"stale counts")
+                    f"run must either redistribute the snapshot through "
+                    f"checkpoint/reshard (shard.reshard.on.restore=true "
+                    f"on the restore path) or start from a clean "
+                    f"accumulator, never fold stale counts")
         fbc = pcc = None
         if self.needs_counts and self.gk in acc:
             fbc, pcc = pallas_hist.counts_from_cooc(

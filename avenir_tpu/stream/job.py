@@ -73,7 +73,13 @@ class StreamAnalytics(Job):
         if shard is not None:
             shard.announce()     # journal the hardware identity (round 12)
         detector = DriftDetector.from_conf(conf, counters)
-        ckpt = WindowCheckpointer.from_conf(conf)
+        # one conf-driven fault plan shared by every seam (round 16):
+        # fold boundaries (WindowedScan) and checkpoint save/restore
+        # (WindowCheckpointer) count against the same schedule
+        from avenir_tpu.utils.retry import FaultPlan
+
+        fault = FaultPlan.from_conf(conf)
+        ckpt = WindowCheckpointer.from_conf(conf, fault=fault)
         if ckpt is not None and detector is not None:
             # the detector's reference/streak ride the ring snapshot: the
             # on_window callback below runs at EMISSION, before the pane's
@@ -99,7 +105,7 @@ class StreamAnalytics(Job):
             counters=counters, checkpointer=ckpt,
             crash_after_panes=conf.get_int("stream.fault.crash.after.panes",
                                            0),
-            on_window=handle)
+            on_window=handle, fault=fault)
         skip = ckpt.restore_into(ws) if ckpt is not None else 0
         if conf.get_bool("stream.warmup.on.start", True):
             ws.warm()

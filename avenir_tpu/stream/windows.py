@@ -151,7 +151,8 @@ class WindowedScan:
                  mesh=None, pad_pow2: bool = True, retain_rows: bool = False,
                  counters: Optional[Counters] = None,
                  checkpointer: Optional["WindowCheckpointer"] = None,
-                 crash_after_panes: int = 0, on_window=None, shard=None):
+                 crash_after_panes: int = 0, on_window=None, shard=None,
+                 fault=None):
         if not encoder.schema_complete(with_labels=True) or \
                 not encoder.class_values:
             raise ConfigError(
@@ -178,6 +179,10 @@ class WindowedScan:
         self.counters = counters if counters is not None else Counters()
         self.checkpointer = checkpointer
         self.crash_after = int(crash_after_panes)
+        # conf-driven fault plan (utils/retry.FaultPlan, round 16): the
+        # "fold" site fires at non-empty pane fold boundaries — the
+        # mid-fold kill the preemption drill injects
+        self.fault = fault
         # invoked per window AT EMISSION — i.e. BEFORE the pane's
         # checkpoint snapshot is written, so state the callback mutates
         # (a drift detector attached to the checkpointer) rides the SAME
@@ -266,6 +271,11 @@ class WindowedScan:
 
         prof = _profile.profiler()
         if lines:
+            if self.fault is not None:
+                # mid-fold kill: the popped pane's rows are past the
+                # cursor (rows_consumed counts CLOSED panes only), so a
+                # resume re-feeds them — nothing is lost or double-counted
+                self.fault.hit("fold")
             ds = self._encode(lines)
             ds = self._pad(ds)
             key = tel.CompileKeyMonitor.shape_key(
@@ -292,6 +302,12 @@ class WindowedScan:
         out = self._emit_windows()
         if self.checkpointer is not None:
             self.checkpointer.maybe_save(self)
+        # legacy knob, kept distinct from the FaultPlan "fold" site on
+        # purpose: stream.fault.crash.after.panes fires AFTER the pane
+        # reached the ring and its snapshot was saved (the round-11
+        # kill-AFTER-durability drill), while fault.fold.crash.after
+        # fires BEFORE the fold (mid-fold preemption) and journals
+        # fault.injected — different drills, both pinned by tests
         if self.crash_after and self.panes_closed >= self.crash_after:
             raise RuntimeError(
                 f"stream.fault.crash.after.panes={self.crash_after}: "
@@ -351,11 +367,16 @@ class WindowedScan:
         when re-fed from row ``rows_consumed``.  Raw retained lines are NOT
         persisted (they exist for retraining, not correctness); the open
         pane's buffered rows are NOT persisted either — the cursor points
-        at the last closed pane boundary, so a resume re-feeds them."""
+        at the last closed pane boundary, so a resume re-feeds them.
+        ``"shard"`` records the mesh topology the panes were folded under
+        (ElasticGraft, round 16): a resharded resume routes through the
+        redistribution transform instead of tripping the foreign-g:-key
+        refusal with a confusing message."""
         return {
             "pane": self.panes_closed,
             "windows": self.windows_emitted,
             "rows_consumed": self.rows_consumed,
+            "shard": self.folder.g_suffix,
             "ring": [{"pane": rec["pane"], "rows": rec["rows"],
                       "state": dict(rec["state"])} for rec in self._ring],
         }
@@ -388,16 +409,25 @@ class WindowCheckpointer:
     """
 
     def __init__(self, directory: str, run_id: str = "",
-                 interval_panes: int = 8, resume: bool = False):
+                 interval_panes: int = 8, resume: bool = False,
+                 reshard: bool = False, fault=None):
         from avenir_tpu.utils.checkpoint import CheckpointManager
 
         self.directory = directory
         self.run_id = run_id
         self.interval = max(int(interval_panes), 1)
+        # ElasticGraft (round 16): shard.reshard.on.restore — redistribute
+        # a snapshot written under a different mesh topology onto this
+        # run's (checkpoint/reshard.py) instead of refusing it.  Default
+        # OFF: crossing a topology boundary silently is never the default
+        self.reshard = bool(reshard)
+        self.fault = fault               # utils/retry.FaultPlan or None
         self.mgr = CheckpointManager(directory, keep=2)
         self._components: Dict[str, Any] = {}
         self.restored: Optional[dict] = None
         if resume:
+            if self.fault is not None:
+                self.fault.hit("checkpoint.restore")
             state = self.mgr.restore()
             if state is not None:
                 snap_run = str(state.get("run", ""))
@@ -410,7 +440,8 @@ class WindowCheckpointer:
                 self.restored = state
 
     @classmethod
-    def from_conf(cls, conf: JobConfig) -> Optional["WindowCheckpointer"]:
+    def from_conf(cls, conf: JobConfig,
+                  fault=None) -> Optional["WindowCheckpointer"]:
         from avenir_tpu.jobs.base import StreamCheckpointer
 
         directory = conf.get("stream.checkpoint.dir")
@@ -420,7 +451,9 @@ class WindowCheckpointer:
             directory,
             run_id=StreamCheckpointer.run_id_from_conf(conf),
             interval_panes=conf.get_int("stream.checkpoint.interval.panes", 8),
-            resume=conf.get_bool("stream.resume", False))
+            resume=conf.get_bool("stream.resume", False),
+            reshard=conf.get_bool("shard.reshard.on.restore", False),
+            fault=fault)
 
     def attach(self, key: str, component) -> None:
         """Register a sidecar whose ``state()``/``load()`` rides the ring
@@ -433,11 +466,74 @@ class WindowCheckpointer:
     def restore_into(self, ws: WindowedScan) -> int:
         """Load the restored snapshot (if any) into ``ws`` and every
         attached component; returns the row cursor the caller must re-feed
-        from (0 on a fresh start)."""
+        from (0 on a fresh start).
+
+        Elastic restore (round 16): a snapshot written under a DIFFERENT
+        mesh topology than ``ws`` folds under is redistributed through
+        ``ChunkFolder.adopt_state`` when ``shard.reshard.on.restore`` is
+        set (journaled as ``checkpoint.reshard``) and refused loudly
+        otherwise — never folded silently.  Same-topology snapshots load
+        exactly as before, byte-for-byte."""
         if self.restored is None:
             return 0
-        ws.load(self.restored)
-        extras = self.restored.get("extras") or {}
+        state = self.restored
+        from avenir_tpu.checkpoint import reshard as _reshard
+
+        snap_sfx = _reshard.snapshot_suffix(state)
+        cur_sfx = ws.folder.g_suffix
+        # the gate triggers on the KEY FAMILY, not just the mesh suffix:
+        # a kernel↔einsum ROUTING crossing at the same topology (a
+        # TPU-written snapshot restored on a CPU host) re-keys too, and
+        # loading it unadopted would silently drop post-resume counts
+        # from the merged window tables — the exact hazard class the
+        # foreign-key refusal exists for
+        ring = state.get("ring") or []
+        mismatch = any(
+            not ws.folder.state_matches_routing(rec.get("state") or {})
+            for rec in ring)
+        if mismatch:
+            snap_einsum = any("fc" in (rec.get("state") or {})
+                              for rec in ring)
+            if snap_einsum and ws.folder.step != "einsum":
+                # einsum→gram is genuinely non-portable (pair tensors
+                # outside the persisted union were never aggregated) —
+                # recommending the reshard gate here would dead-end in
+                # the same ReshardError adopt_state raises
+                raise ConfigError(
+                    f"stream snapshot in {self.directory!r} was written "
+                    f"under the chunked-einsum count routing ('fc'/"
+                    f"'pcc<off>' keys) but this run folds the fused "
+                    f"gram — einsum counts cannot be promoted onto a "
+                    f"gram routing; resume on a matching routing (e.g. "
+                    f"the unsharded CPU path), or clear the directory "
+                    f"and restart the stream")
+            if not self.reshard:
+                if snap_sfx is not None and snap_sfx != cur_sfx:
+                    written, reads = (_reshard.describe(snap_sfx),
+                                      _reshard.describe(cur_sfx))
+                else:
+                    written = "the fused gram routing"
+                    reads = ("the chunked-einsum count routing"
+                             if ws.folder.step == "einsum"
+                             else "a differently-keyed gram routing")
+                raise ConfigError(
+                    f"stream snapshot in {self.directory!r} was written "
+                    f"under {written!r} but this run folds under "
+                    f"{reads!r} — set shard.reshard.on.restore=true to "
+                    f"redistribute the snapshot onto the new layout "
+                    f"(ElasticGraft, "
+                    f"docs/runbooks/preemption_recovery.md), or clear "
+                    f"the directory and restart the stream")
+            rekeyed: List[str] = []
+            for rec in ring:
+                rec["state"], moved = ws.folder.adopt_state(rec["state"])
+                rekeyed.extend(moved)
+            state["shard"] = cur_sfx
+            _reshard.journal_reshard(
+                snap_sfx if snap_sfx is not None else "", cur_sfx,
+                len(rekeyed), directory=self.directory, run=self.run_id)
+        ws.load(state)
+        extras = state.get("extras") or {}
         for key, component in self._components.items():
             if key in extras:
                 component.load(extras[key])
@@ -451,6 +547,11 @@ class WindowCheckpointer:
             self.save(ws)
 
     def save(self, ws: WindowedScan) -> None:
+        if self.fault is not None:
+            # BEFORE any write: an injected save-crash must leave the
+            # previous snapshot whole (save_state is atomic anyway; the
+            # site exists to drill the window before it runs at all)
+            self.fault.hit("checkpoint.save")
         # "run" fingerprints the writing configuration (GL002): restore
         # rejects a snapshot whose run id differs
         state = ws.state()

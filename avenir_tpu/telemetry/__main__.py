@@ -7,8 +7,11 @@ Subcommands (the bare ``<journal>`` form keeps rendering the span tree):
   span with its wall duration, the slowest root→leaf path highlighted
   (``◀`` — the first place to look in a slow run), still-open spans
   flagged (``OPEN`` — the first place to look in a *wedged* run), counter
-  deltas between successive snapshots of the same scope, and a one-line
-  tally of the free events (checkpoints, recompiles, gauges, canaries).
+  deltas between successive snapshots of the same scope, a durability
+  timeline (checkpoint saves/restores, ElasticGraft ``checkpoint.reshard``
+  topology crossings, ``fault.injected`` drill kills — the preemption
+  story in time order, round 16), and a one-line tally of the free
+  events (checkpoints, recompiles, gauges, canaries).
   A merged fleet view (≥ 2 writers) attributes every span to its writer
   (``proc=…``/``replica=…``).
 - ``merge <dir>`` — GraftFleet federation (round 15): time-order one
@@ -169,6 +172,30 @@ def counter_deltas(events: List[dict]) -> List[str]:
     return out
 
 
+def durability_lines(events: List[dict]) -> List[str]:
+    """The run's durability timeline (round 16): checkpoint lifecycle,
+    ElasticGraft topology crossings and injected drill faults, in journal
+    order — `kill → fault.injected → restore → checkpoint.reshard` reads
+    straight down, which is how a preemption drill is triaged."""
+    out: List[str] = []
+    for e in events:
+        ev = e.get("ev")
+        if ev in ("checkpoint.save", "checkpoint.restore"):
+            detail = (f"run={e.get('run', '?')} chunk={e.get('chunk', '?')} "
+                      f"rows={e.get('rows', '?')}"
+                      if "chunk" in e else
+                      f"scope={e.get('scope', '?')}")
+            out.append(f"  {ev:<20} {detail}")
+        elif ev == "checkpoint.reshard":
+            out.append(f"  {ev:<20} {e.get('src', '?')} -> "
+                       f"{e.get('dst', '?')} ({e.get('keys', 0)} key(s)) "
+                       f"run={e.get('run', '?')}")
+        elif ev == "fault.injected":
+            out.append(f"  {ev:<20} site={e.get('site', '?')} "
+                       f"hit={e.get('hit', '?')}")
+    return out
+
+
 def render(events: List[dict], trace_filter: Optional[str] = None
            ) -> List[str]:
     traces = build_traces(events)
@@ -191,6 +218,11 @@ def render(events: List[dict], trace_filter: Optional[str] = None
     if deltas:
         out.append("counter deltas:")
         out.extend(deltas)
+        out.append("")
+    durability = durability_lines(events)
+    if durability:
+        out.append("durability timeline:")
+        out.extend(durability)
         out.append("")
     tally: Dict[str, int] = {}
     for event in events:

@@ -32,6 +32,13 @@ _DICT_TAG = "__dict__"
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
+class CheckpointError(RuntimeError):
+    """A snapshot that cannot be restored WHOLE: torn structure, missing
+    array payload, or a directory that vanished mid-read.  Restore paths
+    must surface this loudly — a partial tree restoring silently is the
+    corruption class the atomic save discipline exists to prevent."""
+
+
 def _escape(key: str) -> str:
     """Array-namespace path escaping: user dict keys may contain '/' (ids are
     user-controlled), which must not collide with the path separator."""
@@ -73,7 +80,16 @@ def _flatten(tree: Any, prefix: str, arrays: Dict[str, np.ndarray]) -> Any:
 def _unflatten(node: Any, arrays: Dict[str, np.ndarray]) -> Any:
     if isinstance(node, dict):
         if _ARRAY_TAG in node and len(node) == 1:
-            return arrays[node[_ARRAY_TAG]]
+            ref = node[_ARRAY_TAG]
+            if ref not in arrays:
+                # the structure references an array the payload lacks: a
+                # torn snapshot (external interference — the atomic save
+                # never produces this) must refuse, not restore partially
+                raise CheckpointError(
+                    f"snapshot structure references array {ref!r} missing "
+                    f"from arrays.npz — torn snapshot; refusing to "
+                    f"restore a partial tree")
+            return arrays[ref]
         if _TUPLE_TAG in node and len(node) == 1:
             return tuple(_unflatten(v, arrays) for v in node[_TUPLE_TAG])
         if _DICT_TAG in node and len(node) == 1:
@@ -121,7 +137,13 @@ def load_state(path: str) -> Any:
         # crash during an overwrite swap: the complete old snapshot is at .bak
         path = path.rstrip(os.sep) + ".bak"
     with open(os.path.join(path, "state.json")) as fh:
-        structure = json.load(fh)
+        try:
+            structure = json.load(fh)
+        except ValueError as e:
+            raise CheckpointError(
+                f"snapshot structure {path!r}/state.json is not valid "
+                f"JSON ({e}) — torn snapshot; refusing to restore a "
+                f"partial tree") from e
     npz_path = os.path.join(path, "arrays.npz")
     arrays = dict(np.load(npz_path, allow_pickle=False)) if os.path.exists(npz_path) else {}
     return _unflatten(structure, arrays)
@@ -182,12 +204,42 @@ class CheckpointManager:
         steps = self._steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: Optional[int] = None) -> Optional[Any]:
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                return None
-        return load_state(os.path.join(self.directory, f"step_{step}"))
+    def restore(self, step: Optional[int] = None, *,
+                reshard_to=None) -> Optional[Any]:
+        """Restore a snapshot — whole, or not at all.
+
+        Latest-step restore (``step=None``) tolerates a snapshot that
+        VANISHES between the directory listing and the read (a concurrent
+        retention sweep racing ``_steps()``): it falls back to the next-
+        newest intact snapshot.  A TORN snapshot raises
+        :class:`CheckpointError` instead — torn state means external
+        interference the caller must surface, never silently skip.
+
+        ``reshard_to`` (ElasticGraft, round 16): a target topology — a
+        ``parallel/shard.ShardSpec``, a ``:mesh:<axis><n>`` suffix
+        string, or ``""`` for unsharded — to redistribute every
+        mesh-qualified accumulator entry of the restored tree onto
+        (``checkpoint/reshard.py``; raises ``ReshardError`` on genuinely
+        non-portable state).  The default None means DO NOT reshard:
+        the tree comes back exactly as written, mesh qualifiers
+        included — pass the empty string, not None, to strip them."""
+        steps = [step] if step is not None else \
+            list(reversed(self._steps()))
+        state = missing = object()
+        for s in steps:
+            try:
+                state = load_state(os.path.join(self.directory, f"step_{s}"))
+                break
+            except FileNotFoundError:
+                if step is not None:
+                    raise
+        if state is missing:
+            return None
+        if reshard_to is not None:
+            from avenir_tpu.checkpoint import reshard
+
+            state, _ = reshard.reshard_state_tree(state, reshard_to)
+        return state
 
     def clear(self) -> None:
         """Remove every manager-owned entry (``step_N`` snapshots, their

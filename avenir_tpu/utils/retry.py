@@ -24,9 +24,11 @@ fault-free results survive injected crashes (tests/test_hardening.py).
 from __future__ import annotations
 
 import logging
+import random
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Iterable, List, Optional, Sequence, Tuple, TypeVar
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple, TypeVar)
 
 from avenir_tpu.utils.metrics import Counters
 
@@ -50,12 +52,46 @@ class RetryPolicy:
     each re-attempt (0 for in-process compute retries; nonzero for I/O).
     ``retryable`` filters which exception types are retried — anything else
     propagates immediately (a schema error will not pass on attempt 2).
+
+    ``jitter`` (round 16, default on — ``retry.jitter``): decorrelated
+    jitter on the backoff, so N replicas that all failed on one shared
+    resource (a checkpoint store, a queue endpoint) re-arrive spread out
+    instead of thundering-herding it in lockstep.  Each sleep draws
+    uniformly from ``[backoff_s, 3·previous_sleep]``, capped at
+    ``backoff_cap_s`` (default 16× base) — the bounds
+    :meth:`next_backoff` pins in tests.  Off, the fixed-``backoff_s``
+    schedule is exactly the pre-round-16 behavior.
     """
 
     max_attempts: int = 2
     backoff_s: float = 0.0
     retryable: Tuple[type, ...] = (Exception,)
     non_retryable: Tuple[type, ...] = ()
+    jitter: bool = True
+    backoff_cap_s: float = 0.0           # 0 = 16 × backoff_s
+    # injectable uniform(a, b) draw — tests pin the distribution bounds
+    # through it; random.uniform in production
+    uniform: Callable[[float, float], float] = random.uniform
+
+    @property
+    def cap_s(self) -> float:
+        # never below base: an inverted cap (cap < base) would silently
+        # break the documented [base, cap] floor
+        if self.backoff_cap_s > 0:
+            return max(self.backoff_cap_s, self.backoff_s)
+        return 16.0 * self.backoff_s
+
+    def next_backoff(self, prev_sleep_s: float) -> float:
+        """The sleep before the next attempt given the previous sleep
+        (pass 0 before the first retry).  With jitter on:
+        ``min(cap, uniform(base, 3·max(prev, base)))`` — the AWS
+        "decorrelated jitter" recipe, bounded to ``[base, cap]``."""
+        if self.backoff_s <= 0:
+            return 0.0
+        if not self.jitter:
+            return self.backoff_s
+        upper = 3.0 * max(prev_sleep_s, self.backoff_s)
+        return min(self.cap_s, self.uniform(self.backoff_s, upper))
 
     @classmethod
     def from_conf(cls, conf) -> "RetryPolicy":
@@ -72,7 +108,10 @@ class RetryPolicy:
                                 conf.get("mapred.map.max.attempts", 2)))
         backoff = float(conf.get("task.retry.backoff.sec", 0.0))
         return cls(max_attempts=max(attempts, 1), backoff_s=backoff,
-                   non_retryable=(ConfigError,))
+                   non_retryable=(ConfigError,),
+                   jitter=conf.get_bool("retry.jitter", True),
+                   backoff_cap_s=conf.get_float(
+                       "task.retry.backoff.cap.sec", 0.0))
 
 
 class TaskExhaustedError(RuntimeError):
@@ -93,6 +132,7 @@ def run_with_retry(fn: Callable[[], R], *, policy: RetryPolicy,
     final failed attempt. ``fn`` must be safe to re-run (pure, or idempotent
     against external state)."""
     last: Optional[BaseException] = None
+    sleep_s = 0.0
     for attempt in range(1, policy.max_attempts + 1):
         if counters is not None:
             counters.increment(*ATTEMPTS)
@@ -107,7 +147,8 @@ def run_with_retry(fn: Callable[[], R], *, policy: RetryPolicy,
             log.warning("task %s attempt %d/%d failed: %r",
                         task, attempt, policy.max_attempts, e)
             if attempt < policy.max_attempts and policy.backoff_s > 0:
-                time.sleep(policy.backoff_s)
+                sleep_s = policy.next_backoff(sleep_s)
+                time.sleep(sleep_s)
     if counters is not None:
         counters.increment(*EXHAUSTED)
     assert last is not None
@@ -158,6 +199,70 @@ class FaultInjector:
             self.faults_fired += 1
             raise self._exc()
         return self._fn(*args, **kwargs)
+
+
+class FaultPlan:
+    """Conf-driven deterministic fault schedule — the ``fault.*`` family
+    (round 16): :class:`FaultInjector` generalized from wrap-one-callable
+    to named SITES any seam can consult, so a preemption drill arms
+    crashes from configuration alone (no test-only wiring).
+
+    - ``fault.fold.crash.after`` — raise on the N-th fold boundary
+      (``stream/windows.py::WindowedScan.close_pane``, before the pane's
+      state reaches the ring: a mid-fold kill, the preemption shape);
+    - ``fault.checkpoint.save.crash.after`` — raise on the N-th snapshot
+      save, BEFORE anything is written (the save must stay atomic);
+    - ``fault.checkpoint.restore.crash.after`` — raise on the N-th
+      restore attempt (a worker preempted while coming back up).
+
+    Each firing journals a golden-schema'd ``fault.injected`` event
+    (site, 1-based hit number) so the run's trace explains the drill.
+    Counts are per-plan-instance; build one plan per run seam
+    (``from_conf`` returns None when no ``fault.*`` key is armed — the
+    zero-cost default)."""
+
+    SITES = ("fold", "checkpoint.save", "checkpoint.restore")
+
+    def __init__(self, schedule: Dict[str, int]):
+        unknown = set(schedule) - set(self.SITES)
+        if unknown:
+            raise ValueError(f"unknown fault sites {sorted(unknown)}; "
+                             f"known: {self.SITES}")
+        self.schedule = {site: int(n) for site, n in schedule.items()
+                         if int(n) > 0}
+        self.hits = {site: 0 for site in self.SITES}
+        self.faults_fired = 0
+
+    @classmethod
+    def from_conf(cls, conf) -> Optional["FaultPlan"]:
+        # literal key reads, one per site: the GL004 registry scans
+        # conf.get* literals, so the fault.* family stays documented
+        sched = {
+            "fold": conf.get_int("fault.fold.crash.after", 0) or 0,
+            "checkpoint.save":
+                conf.get_int("fault.checkpoint.save.crash.after", 0) or 0,
+            "checkpoint.restore":
+                conf.get_int("fault.checkpoint.restore.crash.after", 0) or 0,
+        }
+        plan = cls(sched)
+        return plan if plan.schedule else None
+
+    def hit(self, site: str) -> None:
+        """Count one pass through ``site``; raise :class:`InjectedFault`
+        (journaled first) when the schedule says this is the one."""
+        if site not in self.hits:
+            raise ValueError(f"unknown fault site {site!r}; "
+                             f"known: {self.SITES}")
+        self.hits[site] += 1
+        if self.hits[site] == self.schedule.get(site, 0):
+            self.faults_fired += 1
+            from avenir_tpu.telemetry import spans as tel
+
+            tel.tracer().event("fault.injected", site=site,
+                               hit=self.hits[site])
+            raise InjectedFault(
+                f"fault.{site}.crash.after={self.hits[site]}: injected "
+                f"crash at {site} boundary {self.hits[site]}")
 
 
 @dataclass

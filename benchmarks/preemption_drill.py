@@ -1,0 +1,186 @@
+"""Preemption drill — kill on 8 devices mid-fold, resume on 4,
+byte-identical (ElasticGraft, round 16).
+
+The robustness claim of ROADMAP item 3 as a runnable artifact: a sharded
+windowed stream with pane-ring checkpoints is killed MID-FOLD by the
+conf-driven fault family (``fault.fold.crash.after`` —
+``utils/retry.py::FaultPlan``), resumed on a 4-device mesh with
+``shard.reshard.on.restore=true``, and the resumed job output is
+asserted byte-identical to an unkilled UNSHARDED run's tail — then the
+journal is checked for the ``fault.injected`` / ``checkpoint.restore`` /
+``checkpoint.reshard`` events that explain the drill (the durability
+timeline ``python -m avenir_tpu.telemetry tree`` renders).
+
+Run on any host — the drill forces an 8-device host mesh itself::
+
+    python benchmarks/preemption_drill.py [--rows 4000] [--json out.json]
+
+Exits 0 with a JSON artifact on byte-identity; raises on any mismatch.
+The same sequence is gated in tier-1 by
+``tests/test_reshard.py::test_preemption_drill_subprocess``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_host_mesh() -> None:
+    """Force the 8-device CPU host mesh BEFORE jax initializes; if jax
+    already initialized this process with fewer devices, exit with an
+    instruction to relaunch fresh (an in-place re-shape is impossible)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "jax" in sys.modules:                      # pragma: no cover
+        import jax
+
+        if jax.device_count() < 8:
+            raise SystemExit(
+                "jax already initialized with <8 devices; run this "
+                "script fresh with XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8")
+
+
+def build_workload(tmp: str, rows: int):
+    """A synthetic labeled CSV + schema file (1/16-grid continuous
+    values — the byte-identity scope docs/streaming.md documents)."""
+    import numpy as np
+
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+
+    f, b, c, fc = 4, 5, 2, 2
+    rng = np.random.default_rng(16)
+    codes = rng.integers(0, b, size=(rows, f)).astype(np.int32)
+    cont = (rng.integers(0, 16, size=(rows, fc)) / 16.0).astype(np.float32)
+    labels = rng.integers(0, c, size=rows).astype(np.int32)
+    fields = [{"name": "id", "ordinal": 0, "id": True, "dataType": "string"}]
+    for j in range(f):
+        fields.append({"name": f"f{j}", "ordinal": 1 + j, "feature": True,
+                       "dataType": "categorical",
+                       "cardinality": [str(v) for v in range(b)]})
+    for j in range(fc):
+        fields.append({"name": f"x{j}", "ordinal": 1 + f + j,
+                       "feature": True, "dataType": "double"})
+    fields.append({"name": "cls", "ordinal": 1 + f + fc,
+                   "dataType": "categorical", "cardinality": ["a", "b"]})
+    schema = FeatureSchema.from_json({"fields": fields})
+    DatasetEncoder(schema)                        # validates completeness
+    lines = [",".join([f"r{i}"] + [str(int(v)) for v in codes[i]]
+                      + [repr(float(x)) for x in cont[i]]
+                      + [["a", "b"][int(labels[i])]])
+             for i in range(rows)]
+    data = os.path.join(tmp, "data.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    schema_path = os.path.join(tmp, "schema.json")
+    with open(schema_path, "w") as fh:
+        json.dump(schema.to_json(), fh)
+    return data, schema_path
+
+
+def run_drill(tmp: str, rows: int) -> dict:
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry.journal import read_events
+    from avenir_tpu.utils.retry import InjectedFault
+
+    data, schema_path = build_workload(tmp, rows)
+    tel_dir = os.path.join(tmp, "tel")
+    props = {"feature.schema.file.path": schema_path,
+             "stream.pane.rows": "128", "stream.window.panes": "2",
+             "stream.slide.panes": "1",
+             "stream.consumers": "classDistribution,naiveBayes",
+             "stream.checkpoint.dir": os.path.join(tmp, "ring"),
+             "stream.checkpoint.interval.panes": "2",
+             "trace.on": "true", "trace.journal.dir": tel_dir}
+
+    # the oracle: the unkilled 1-chip (unsharded) run, no drill knobs
+    golden_props = {k: v for k, v in props.items()
+                    if not k.startswith("stream.checkpoint")}
+    get_job("StreamAnalytics").run(JobConfig(dict(golden_props)), data,
+                                   os.path.join(tmp, "out_golden"))
+    with open(os.path.join(tmp, "out_golden", "part-00000")) as fh:
+        golden = fh.read()
+
+    # kill on 8, mid-fold
+    killed_at = 6
+    try:
+        get_job("StreamAnalytics").run(
+            JobConfig({**props, "shard.devices": "8",
+                       "fault.fold.crash.after": str(killed_at)}),
+            data, os.path.join(tmp, "out_killed"))
+        raise AssertionError("injected fold fault never fired")
+    except InjectedFault:
+        pass
+
+    # resume on 4, redistribution gated ON
+    counters = get_job("StreamAnalytics").run(
+        JobConfig({**props, "shard.devices": "4", "stream.resume": "true",
+                   "shard.reshard.on.restore": "true"}),
+        data, os.path.join(tmp, "out_resumed"))
+    tel.tracer().disable()
+    with open(os.path.join(tmp, "out_resumed", "part-00000")) as fh:
+        resumed = fh.read()
+    identical = bool(resumed) and golden.endswith(resumed)
+    if not identical:
+        raise AssertionError(
+            "resumed output is NOT the unkilled unsharded run's tail — "
+            "the byte-identity claim failed")
+
+    events: list = []
+    for name in sorted(os.listdir(tel_dir)):
+        if name.endswith(".jsonl"):
+            events.extend(read_events(os.path.join(tel_dir, name)))
+    tally: dict = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev in ("fault.injected", "checkpoint.save",
+                  "checkpoint.restore", "checkpoint.reshard"):
+            tally[ev] = tally.get(ev, 0) + 1
+    reshards = [e for e in events if e.get("ev") == "checkpoint.reshard"]
+    assert tally.get("fault.injected") == 1, tally
+    assert tally.get("checkpoint.reshard") == 1, tally
+    return {
+        "drill": "preemption",
+        "rows": rows,
+        "killed_on_devices": 8,
+        "killed_at_fold": killed_at,
+        "resumed_on_devices": 4,
+        "resumed_windows": int(counters.get("Stream", "windows") or 0),
+        "byte_identical_to_unsharded": identical,
+        "reshard": {"src": reshards[0].get("src"),
+                    "dst": reshards[0].get("dst"),
+                    "keys": reshards[0].get("keys")},
+        "journal_events": tally,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=4000)
+    ap.add_argument("--json", default=None,
+                    help="also write the artifact to this path")
+    args = ap.parse_args(argv)
+    _force_host_mesh()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        artifact = run_drill(tmp, args.rows)
+    text = json.dumps(artifact, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as fh:
+            fh.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,255 @@
+"""Subprocess worker for the ElasticGraft preemption drill (round 16).
+
+Launched by tests/test_reshard.py with ``JAX_PLATFORMS=cpu`` and
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` set EXPLICITLY in
+the child environment (the tests/shard_worker.py discipline): the
+8-device host mesh is forced here, not inherited, so the gate holds in
+any environment with zero TPUs attached.
+
+The drill — ROADMAP open item 3 as a machine-checked artifact:
+
+1. run a sharded WindowedScan on an 8-device mesh with pane-ring
+   checkpoints and a conf-driven injected kill mid-fold
+   (``fault.fold.crash.after`` — utils/retry.FaultPlan);
+2. resume the SAME stream on a 4-device mesh with
+   ``shard.reshard.on.restore=true``: the snapshot's mesh-qualified
+   accumulator state is redistributed (``checkpoint/reshard.py``), and
+   every window emitted after the resume must be byte-identical to the
+   unkilled SINGLE-CHIP run's — for every SharedScan consumer (NB, MI,
+   correlation, Fisher, moments);
+3. the same kill → reshard → resume at the JOB level (StreamAnalytics):
+   the resumed part file must equal the unkilled unsharded run's tail
+   byte-for-byte, and the journal must carry the golden-schema'd
+   ``fault.injected`` and ``checkpoint.reshard`` events that explain the
+   drill.
+
+Prints ``reshard worker ok`` and exits 0 on success.
+"""
+
+import os
+import sys
+
+# the mesh must exist before jax initializes — the whole point of running
+# in a fresh subprocess (the parent cannot re-shape an initialized jax)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def build_inputs(n, f, b, c, fc):
+    """A schema-complete encoder + the raw CSV rows of a synthetic
+    labeled stream (1/16-grid continuous values: pane/shard f32 partial
+    sums are exact, so moment tables are byte-identical under ANY
+    summation order — the docs/streaming.md scope)."""
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+
+    rng = np.random.default_rng(16)
+    codes = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    cont = (rng.integers(0, 16, size=(n, fc)) / 16.0).astype(np.float32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    fields = [{"name": "id", "ordinal": 0, "id": True, "dataType": "string"}]
+    for j in range(f):
+        fields.append({"name": f"f{j}", "ordinal": 1 + j, "feature": True,
+                       "dataType": "categorical",
+                       "cardinality": [str(v) for v in range(b)]})
+    for j in range(fc):
+        fields.append({"name": f"x{j}", "ordinal": 1 + f + j,
+                       "feature": True, "dataType": "double"})
+    fields.append({"name": "cls", "ordinal": 1 + f + fc,
+                   "dataType": "categorical", "cardinality": ["a", "b"]})
+    enc = DatasetEncoder(FeatureSchema.from_json({"fields": fields}))
+    lines = [",".join([f"r{i}"] + [str(int(v)) for v in codes[i]]
+                      + [repr(float(x)) for x in cont[i]]
+                      + [["a", "b"][int(labels[i])]])
+             for i in range(n)]
+    return enc, lines
+
+
+def drill_windowed_scan(enc, lines, tmp):
+    """Kill on 8 mid-fold, resume on 4, byte-identical to the unkilled
+    1-chip fold — at WindowedScan level, every consumer."""
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.pipeline import scan
+    from avenir_tpu.stream.windows import WindowCheckpointer, WindowedScan
+    from avenir_tpu.utils.retry import FaultPlan, InjectedFault
+
+    def spec(d):
+        return ShardSpec.from_conf(JobConfig({"shard.devices": str(d)}))
+
+    def consumers():
+        return [scan.NaiveBayesConsumer(name="nb"),
+                scan.MutualInfoConsumer(name="mi"),
+                scan.CorrelationConsumer(name="cramer", against_class=True),
+                scan.FisherConsumer(name="fisher"),
+                scan.MomentsConsumer(name="moments")]
+
+    def windowed(shard=None, checkpointer=None, fault=None):
+        return WindowedScan(enc, consumers(), pane_rows=256, window_panes=2,
+                            slide_panes=1, shard=shard,
+                            checkpointer=checkpointer, fault=fault)
+
+    # the oracle: the UNKILLED 1-chip (unsharded) fold
+    oracle_ws = windowed()
+    oracle = oracle_ws.feed(lines)
+    oracle.extend(oracle_ws.flush())
+    assert oracle, "oracle emitted no windows"
+
+    # kill on 8: injected fault at the 3rd pane-fold boundary (one
+    # snapshot already durable at pane 2)
+    ckdir = os.path.join(tmp, "ring")
+    crashed = windowed(
+        shard=spec(8),
+        checkpointer=WindowCheckpointer(ckdir, run_id="drill",
+                                        interval_panes=2),
+        fault=FaultPlan({"fold": 3}))
+    try:
+        crashed.feed(lines)
+        raise AssertionError("injected fold fault never fired")
+    except InjectedFault:
+        pass
+    assert os.listdir(ckdir), "no snapshot survived the kill"
+
+    # resume on 4: redistribution gated ON
+    ck4 = WindowCheckpointer(ckdir, run_id="drill", interval_panes=2,
+                             resume=True, reshard=True)
+    resumed_ws = windowed(shard=spec(4), checkpointer=ck4)
+    skip = ck4.restore_into(resumed_ws)
+    assert 0 < skip < len(lines), skip
+    resumed = resumed_ws.feed(lines[skip:])
+    resumed.extend(resumed_ws.flush())
+    assert resumed_ws.windows_emitted == len(oracle)
+
+    eq = np.testing.assert_array_equal
+    by_index = {w.index: w for w in resumed}
+    compared = 0
+    for want in oracle:
+        got = by_index.get(want.index)
+        if got is None:
+            continue                    # emitted before the kill
+        eq(got.results["nb"].bin_counts, want.results["nb"].bin_counts)
+        eq(got.results["nb"].class_counts, want.results["nb"].class_counts)
+        eq(got.results["nb"].cont_sum, want.results["nb"].cont_sum)
+        eq(got.results["nb"].cont_sumsq, want.results["nb"].cont_sumsq)
+        eq(got.results["mi"].feature_class_counts,
+           want.results["mi"].feature_class_counts)
+        eq(got.results["mi"].pair_class_counts,
+           want.results["mi"].pair_class_counts)
+        assert got.results["mi"].to_lines() == want.results["mi"].to_lines()
+        eq(got.results["cramer"].contingency,
+           want.results["cramer"].contingency)
+        assert (got.results["cramer"].to_lines()
+                == want.results["cramer"].to_lines())
+        eq(got.results["fisher"].mean, want.results["fisher"].mean)
+        eq(got.results["fisher"].var, want.results["fisher"].var)
+        for g, w in zip(got.results["moments"], want.results["moments"]):
+            eq(g, w)
+        compared += 1
+    assert compared, "resume emitted no window the oracle also emitted"
+    return compared
+
+
+def drill_job_level(enc, lines, tmp):
+    """The same kill → reshard → resume through StreamAnalytics: resumed
+    part file == the unkilled unsharded run's tail, and the journal
+    carries fault.injected + checkpoint.reshard."""
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry.journal import read_events
+    from avenir_tpu.utils.retry import InjectedFault
+
+    import json
+
+    data = os.path.join(tmp, "data.csv")
+    with open(data, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    schema_path = os.path.join(tmp, "schema.json")
+    with open(schema_path, "w") as fh:
+        json.dump(enc.schema.to_json(), fh)
+    tel_dir = os.path.join(tmp, "tel")
+    props = {"feature.schema.file.path": schema_path,
+             "stream.pane.rows": "256", "stream.window.panes": "2",
+             "stream.slide.panes": "1",
+             "stream.consumers": "classDistribution,naiveBayes",
+             "stream.checkpoint.dir": os.path.join(tmp, "jring"),
+             "stream.checkpoint.interval.panes": "2",
+             "trace.on": "true", "trace.journal.dir": tel_dir}
+
+    # the unkilled UNSHARDED oracle (no checkpoint dir: it must not share
+    # the drill's ring, and a clean finish would sweep it anyway)
+    golden_props = {k: v for k, v in props.items()
+                    if not k.startswith("stream.checkpoint")}
+    get_job("StreamAnalytics").run(JobConfig(dict(golden_props)), data,
+                                   os.path.join(tmp, "out_golden"))
+    golden = open(os.path.join(tmp, "out_golden", "part-00000")).read()
+
+    # kill on 8 mid-fold
+    try:
+        get_job("StreamAnalytics").run(
+            JobConfig({**props, "shard.devices": "8",
+                       "fault.fold.crash.after": "3"}),
+            data, os.path.join(tmp, "out_killed"))
+        raise AssertionError("injected fold fault never fired")
+    except InjectedFault:
+        pass
+    assert not os.path.exists(os.path.join(tmp, "out_killed"))
+
+    # resume on 4, redistribution ON
+    counters = get_job("StreamAnalytics").run(
+        JobConfig({**props, "shard.devices": "4", "stream.resume": "true",
+                   "shard.reshard.on.restore": "true"}),
+        data, os.path.join(tmp, "out_resumed"))
+    tel.tracer().disable()
+    resumed = open(os.path.join(tmp, "out_resumed", "part-00000")).read()
+    windows = counters.get("Stream", "windows")
+    assert windows and windows > 0
+    # the resumed run re-emits exactly the tail of the golden output
+    assert resumed and golden.endswith(resumed), (
+        "resumed job output is not the unkilled unsharded run's tail:\n"
+        f"golden tail:\n{golden[-400:]}\nresumed:\n{resumed[-400:]}")
+
+    events = []
+    for name in sorted(os.listdir(tel_dir)):
+        if name.endswith(".jsonl"):
+            events.extend(read_events(os.path.join(tel_dir, name)))
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e.get("ev"), []).append(e)
+    faults = by_ev.get("fault.injected", [])
+    assert [e["site"] for e in faults] == ["fold"], faults
+    reshards = by_ev.get("checkpoint.reshard", [])
+    assert len(reshards) == 1, reshards
+    assert reshards[0]["src"] == ":mesh:data8"
+    assert reshards[0]["dst"] == ":mesh:data4"
+    assert reshards[0]["keys"] > 0
+    assert by_ev.get("checkpoint.restore"), "no checkpoint.restore event"
+    return windows
+
+
+def main() -> None:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == 8, jax.devices()
+
+    # 800 rows / 256-row panes: 3 full panes + a ragged 32-row tail pane
+    # at flush — a snapshot lands at pane 2 before the 3rd-fold kill,
+    # few enough dispatches to keep the tier-1 gate fast
+    enc, lines = build_inputs(n=800, f=4, b=5, c=2, fc=2)
+    with tempfile.TemporaryDirectory() as tmp:
+        compared = drill_windowed_scan(enc, lines, tmp)
+        windows = drill_job_level(enc, lines, tmp)
+    print(f"windows compared: {compared} (scan) / {windows} (job)")
+    print("reshard worker ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -377,6 +377,10 @@ def test_whole_tree_zero_nonbaselined_findings():
     # GraftFleet tests drive federated journals, the skew probe and the
     # SLO CLI, where an undocumented trace.*/shard.skew.*/slo.* key
     # (GL004) or a sync-in-loop around the probe (GL005) would hide
+    # tests/test_reshard.py + reshard_worker.py likewise (round 16) —
+    # the ElasticGraft preemption drill drives checkpoint save/restore/
+    # reshard loops, where an undocumented shard.reshard.*/fault.* key
+    # (GL004) or an unfingerprinted snapshot (GL002) would hide
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
@@ -387,7 +391,9 @@ def test_whole_tree_zero_nonbaselined_findings():
          str(REPO / "tests" / "test_tree.py"),
          str(REPO / "tests" / "test_profile.py"),
          str(REPO / "tests" / "test_fleet.py"),
-         str(REPO / "tests" / "fleet_worker.py")],
+         str(REPO / "tests" / "fleet_worker.py"),
+         str(REPO / "tests" / "test_reshard.py"),
+         str(REPO / "tests" / "reshard_worker.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

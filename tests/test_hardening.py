@@ -274,6 +274,49 @@ def test_retry_policy_honors_reference_property_name():
     assert RetryPolicy.from_conf(JobConfig({})).max_attempts == 2
 
 
+def test_retry_backoff_decorrelated_jitter_bounds():
+    """Round 16: `retry.jitter` (default on) draws each backoff from
+    [base, min(cap, 3·prev)] — the decorrelated-jitter recipe that keeps
+    N replicas retrying a shared resource from thundering-herding it.
+    Pins the DISTRIBUTION bounds, not single draws."""
+    import random as _random
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.utils.retry import RetryPolicy
+
+    rng = _random.Random(16)
+    pol = RetryPolicy(backoff_s=0.5, jitter=True, backoff_cap_s=4.0,
+                      uniform=rng.uniform)
+    assert pol.cap_s == 4.0
+    prev, draws = 0.0, []
+    for _ in range(500):
+        nxt = pol.next_backoff(prev)
+        # the distribution bounds: never below base, never above the cap,
+        # never above 3× the previous sleep (or 3× base on the first)
+        assert 0.5 <= nxt <= 4.0
+        assert nxt <= 3.0 * max(prev, 0.5) + 1e-12
+        draws.append(nxt)
+        prev = nxt
+    # it actually SPREADS (a fixed schedule would collapse to one value)
+    assert max(draws) - min(draws) > 0.5
+    # default cap: 16× base when unset; an inverted cap clamps to base
+    assert RetryPolicy(backoff_s=0.25).cap_s == 4.0
+    assert RetryPolicy(backoff_s=0.5, backoff_cap_s=0.2).cap_s == 0.5
+    # jitter off: exactly the pre-round-16 fixed schedule
+    fixed = RetryPolicy(backoff_s=0.5, jitter=False)
+    assert [fixed.next_backoff(p) for p in (0.0, 0.5, 7.0)] == [0.5] * 3
+    # zero base: no sleeping, jitter or not
+    assert RetryPolicy(backoff_s=0.0).next_backoff(0.0) == 0.0
+    # conf wiring: retry.jitter default on, opt-out honored, cap read
+    on = RetryPolicy.from_conf(JobConfig({}))
+    assert on.jitter is True
+    off = RetryPolicy.from_conf(JobConfig(
+        {"retry.jitter": "false", "task.retry.backoff.sec": "0.5",
+         "task.retry.backoff.cap.sec": "2.0"}))
+    assert off.jitter is False and off.backoff_s == 0.5
+    assert off.backoff_cap_s == 2.0
+
+
 def test_non_retryable_error_propagates_immediately():
     from avenir_tpu.utils.retry import RetryPolicy, run_with_retry
 

@@ -1,0 +1,660 @@
+"""ElasticGraft (round 16): topology-portable checkpoints.
+
+Covers the redistribution transform (``checkpoint/reshard.py``) and its
+seams — ``ChunkFolder.adopt_state`` (refuse OR reshard, never silently
+fold), the ``WindowCheckpointer`` elastic restore under the
+``shard.reshard.on.restore`` gate, ``CheckpointManager.restore
+(reshard_to=...)``, the jobs-layer ``StreamCheckpointer`` gate, the
+``CheckpointManager._recover`` crash matrix, and the telemetry CLI's
+durability timeline — plus the ISSUE-specified preemption drill gate:
+``test_preemption_drill_subprocess`` forces an 8-device host mesh in a
+FRESH child process (tests/reshard_worker.py), kills a sharded run
+mid-fold via the conf-driven ``fault.*`` family, resumes on 4 devices,
+and asserts the resumed tables byte-identical to the unkilled 1-chip
+fold at both WindowedScan and job level.
+
+The in-process tests ride the conftest's forced 8-device host mesh.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from avenir_tpu.checkpoint import reshard
+from avenir_tpu.core.config import ConfigError, JobConfig
+from avenir_tpu.core.encoding import DatasetEncoder, EncodedDataset
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.ops import agg, pallas_hist
+from avenir_tpu.parallel.shard import ShardSpec
+from avenir_tpu.pipeline import scan
+from avenir_tpu.stream.windows import WindowCheckpointer, WindowedScan
+from avenir_tpu.utils import checkpoint as ckpt_mod
+from avenir_tpu.utils.retry import FaultPlan, InjectedFault
+
+N, F, B, C, FC = 768, 4, 5, 2, 2
+
+
+def spec_for(devices):
+    return ShardSpec.from_conf(JobConfig({"shard.devices": str(devices)}))
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(16)
+    codes = rng.integers(0, B, size=(N, F)).astype(np.int32)
+    # 1/16-grid continuous values: partial f32 sums exact (shard tests'
+    # byte-identity scope, docs/streaming.md)
+    cont = (rng.integers(0, 16, size=(N, FC)) / 16.0).astype(np.float32)
+    labels = rng.integers(0, C, size=N).astype(np.int32)
+    return codes, cont, labels
+
+
+def mk_ds(data):
+    codes, cont, labels = data
+    return EncodedDataset(
+        codes=codes, cont=cont, labels=labels,
+        n_bins=np.full(F, B, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(F)),
+        cont_ordinals=list(range(F, F + FC)))
+
+
+def _encoder_and_lines(data):
+    codes, cont, labels = data
+    fields = [{"name": "id", "ordinal": 0, "id": True, "dataType": "string"}]
+    for j in range(F):
+        fields.append({"name": f"f{j}", "ordinal": 1 + j, "feature": True,
+                       "dataType": "categorical",
+                       "cardinality": [str(v) for v in range(B)]})
+    for j in range(FC):
+        fields.append({"name": f"x{j}", "ordinal": 1 + F + j,
+                       "feature": True, "dataType": "double"})
+    fields.append({"name": "cls", "ordinal": 1 + F + FC,
+                   "dataType": "categorical", "cardinality": ["a", "b"]})
+    enc = DatasetEncoder(FeatureSchema.from_json({"fields": fields}))
+    lines = [",".join([f"r{i}"] + [str(int(v)) for v in codes[i]]
+                      + [repr(float(x)) for x in cont[i]]
+                      + [["a", "b"][int(labels[i])]])
+             for i in range(len(labels))]
+    return enc, lines
+
+
+# ---------------------------------------------------------------------------
+# the transform: key algebra
+# ---------------------------------------------------------------------------
+
+def test_split_and_spec_suffix():
+    assert reshard.split_mesh_key("g:cls:f4:b5:c2:mesh:data8") == \
+        ("g:cls:f4:b5:c2", ":mesh:data8")
+    assert reshard.split_mesh_key("g:cls:f4:b5:c2") == \
+        ("g:cls:f4:b5:c2", "")
+    assert reshard.spec_suffix(None) == ""
+    assert reshard.spec_suffix(":mesh:data4") == ":mesh:data4"
+    assert reshard.spec_suffix(spec_for(8)) == ":mesh:data8"
+    with pytest.raises(reshard.ReshardError, match="mesh qualifier"):
+        reshard.spec_suffix("data4")
+
+
+def test_rekey_state_moves_only_mesh_qualified_grams():
+    g = np.arange(8, dtype=np.int64)
+    state = {"g:cls:f4:b5:c2:mesh:data8": g, "class": np.ones(2, np.int64),
+             "cont_sum": np.ones((2, 2))}
+    out, moved = reshard.rekey_state(state, ":mesh:data4")
+    assert moved == ["g:cls:f4:b5:c2:mesh:data8"]
+    assert set(out) == {"g:cls:f4:b5:c2:mesh:data4", "class", "cont_sum"}
+    # values pass through UNTOUCHED — the same bytes under the new key
+    assert out["g:cls:f4:b5:c2:mesh:data4"] is g
+    # idempotent: already-target state comes back unchanged
+    again, moved2 = reshard.rekey_state(out, ":mesh:data4")
+    assert moved2 == [] and set(again) == set(out)
+
+
+def test_rekey_state_refuses_foreign_and_mixed():
+    state = {"g:cls:f4:b5:c2:mesh:data8": np.zeros(1)}
+    with pytest.raises(reshard.ReshardError, match="unknown provenance"):
+        reshard.rekey_state(state, ":mesh:data4", source=":mesh:shards2")
+    mixed = {"g:cls:f4:b5:c2:mesh:data8": np.zeros(1),
+             "g:cls:f4:b5:c2:mesh:data4": np.zeros(1)}
+    with pytest.raises(reshard.ReshardError, match="mixed-topology"):
+        reshard.state_suffix(mixed)
+    with pytest.raises(reshard.ReshardError):
+        reshard.rekey_state(mixed, ":mesh:data2")
+    # collision: both topologies' totals present, one declared source
+    with pytest.raises(reshard.ReshardError, match="collide"):
+        reshard.rekey_state(mixed, ":mesh:data4", source=":mesh:data8")
+
+
+def test_state_and_snapshot_suffix_inference():
+    assert reshard.state_suffix({"class": np.ones(2)}) is None
+    assert reshard.state_suffix({"g:cls:f4:b5:c2": np.ones(2)}) == ""
+    snap = {"ring": [{"state": {}},
+                     {"state": {"g:cls:f4:b5:c2:mesh:data8": np.ones(1)}}]}
+    assert reshard.snapshot_suffix(snap) == ":mesh:data8"
+    assert reshard.snapshot_suffix({"shard": ":mesh:data2"}) == ":mesh:data2"
+    assert reshard.snapshot_suffix({"ring": [{"state": {}}]}) is None
+    bad = {"ring": [{"state": {"g:cls:f4:b5:c2:mesh:data8": np.ones(1)}},
+                    {"state": {"g:cls:f4:b5:c2": np.ones(1)}}],
+           "acc": {}}
+    with pytest.raises(reshard.ReshardError, match="topologies"):
+        reshard.snapshot_suffix(bad)
+
+
+def test_reshard_state_tree_walks_rings_and_acc():
+    g8 = "g:cls:f4:b5:c2:mesh:data8"
+    tree = {"run": "rid", "shard": ":mesh:data8",
+            "ring": [{"pane": 0, "rows": 5, "state": {g8: np.ones(3)}},
+                     {"pane": 1, "rows": 0, "state": {}}],
+            "acc": {g8: np.ones(3), "class": np.ones(2)},
+            "extras": {"lr": {"weights": np.ones(4), "history": [1, 2]}}}
+    out, moved = reshard.reshard_state_tree(tree, spec_for(4))
+    assert len(moved) == 2
+    assert "g:cls:f4:b5:c2:mesh:data4" in out["ring"][0]["state"]
+    assert "g:cls:f4:b5:c2:mesh:data4" in out["acc"]
+    assert out["shard"] == ":mesh:data4"
+    # invariant state (cursors, LR history, class totals) passes through
+    assert out["run"] == "rid" and out["ring"][1]["state"] == {}
+    assert out["extras"]["lr"]["history"] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# ChunkFolder.adopt_state: refuse OR reshard, never silently fold
+# ---------------------------------------------------------------------------
+
+def _fold_state(data, shard=None):
+    """One chunk folded under a topology → (folder, state mapping)."""
+    ds = mk_ds(data)
+    folder = scan.ChunkFolder(
+        [scan.NaiveBayesConsumer(name="nb"),
+         scan.MutualInfoConsumer(name="mi")], ds, shard=shard)
+    acc = agg.Accumulator()
+    folder.fold(ds, acc)
+    return folder, acc.state()
+
+
+def test_adopt_state_rekeys_across_mesh_sizes(data):
+    f8, state8 = _fold_state(data, spec_for(8))
+    f4, _ = _fold_state(data, spec_for(4))
+    assert f8.g_suffix == ":mesh:data8" and f4.g_suffix == ":mesh:data4"
+    adopted, moved = f4.adopt_state(state8)
+    assert moved == [f8.gk]
+    acc = agg.Accumulator()
+    acc.load(adopted)
+    t4 = f4.tables(acc, N)                 # no foreign-key refusal
+    _, base_state = _fold_state(data)      # unsharded einsum oracle
+    base_acc = agg.Accumulator()
+    base_acc.load(base_state)
+    folder_plain = scan.ChunkFolder(
+        [scan.NaiveBayesConsumer(name="nb"),
+         scan.MutualInfoConsumer(name="mi")], mk_ds(data))
+    t1 = folder_plain.tables(base_acc, N)
+    np.testing.assert_array_equal(t4.fbc, t1.fbc)
+    np.testing.assert_array_equal(t4.pcc, t1.pcc)
+    np.testing.assert_array_equal(t4.class_counts, t1.class_counts)
+
+
+def test_adopt_state_demotes_gram_onto_einsum_routing(data):
+    """Sharded gram state restored onto the chunked-einsum routing (the
+    1-chip CPU path) is DEMOTED through counts_from_cooc — the identical
+    read-out tables() runs — so the resumed tables stay byte-identical."""
+    f8, state8 = _fold_state(data, spec_for(8))
+    plain, plain_state = _fold_state(data)          # einsum on CPU
+    assert plain.step == "einsum"
+    adopted, moved = plain.adopt_state(state8)
+    assert moved == [f8.gk]
+    assert "fc" in adopted and not any(k.startswith("g:") for k in adopted)
+    acc = agg.Accumulator()
+    acc.load(adopted)
+    t_adopted = plain.tables(acc, N)
+    base = agg.Accumulator()
+    base.load(plain_state)
+    t_base = plain.tables(base, N)
+    np.testing.assert_array_equal(t_adopted.fbc, t_base.fbc)
+    np.testing.assert_array_equal(t_adopted.pcc, t_base.pcc)
+    # same-routing einsum state passes through untouched
+    same, moved_same = plain.adopt_state(plain_state)
+    assert moved_same == [] and same is plain_state
+
+
+def test_adopt_state_refusals(data):
+    f8, state8 = _fold_state(data, spec_for(8))
+    _, plain_state = _fold_state(data)
+    # einsum counts cannot be PROMOTED onto a gram routing
+    with pytest.raises(reshard.ReshardError, match="promotion"):
+        f8.adopt_state(plain_state)
+    # a foreign base layout (schema shape changed) is non-portable
+    foreign = {"g:cls:f9:b9:c9:mesh:data8": np.zeros((2, 4, 4))}
+    with pytest.raises(reshard.ReshardError, match="base layout"):
+        f8.adopt_state(foreign)
+    # mixed gram + einsum state in one mapping
+    with pytest.raises(reshard.ReshardError, match="mixed-routing"):
+        f8.adopt_state({**state8, "fc": np.zeros((F, B, C))})
+
+
+def test_tables_refusal_names_the_reshard_gate(data):
+    """The foreign-key refusal (PR 7) still fires — and now tells the
+    operator about the redistribution path instead of dead-ending."""
+    f8, state8 = _fold_state(data, spec_for(8))
+    f4, _ = _fold_state(data, spec_for(4))
+    acc = agg.Accumulator()
+    acc.load(state8)
+    with pytest.raises(scan.ScanError,
+                       match="shard.reshard.on.restore"):
+        f4.tables(acc, N)
+
+
+# ---------------------------------------------------------------------------
+# WindowCheckpointer: the elastic restore gate
+#
+# One module-scoped drill fixture: the unkilled UNSHARDED oracle (the
+# byte-identity reference — sharded==unsharded is already proven by
+# tests/test_shard.py) plus ONE kill-on-8 run whose ring directory each
+# test copies, so the expensive 8-device interpret-mode fold runs once.
+# ---------------------------------------------------------------------------
+
+def _consumers():
+    return [scan.NaiveBayesConsumer(name="nb"),
+            scan.MutualInfoConsumer(name="mi")]
+
+
+def _windowed(enc, shard=None, checkpointer=None, fault=None):
+    return WindowedScan(enc, _consumers(), pane_rows=128, window_panes=2,
+                        slide_panes=1, shard=shard,
+                        checkpointer=checkpointer, fault=fault)
+
+
+@pytest.fixture(scope="module")
+def drill(data, tmp_path_factory):
+    enc, lines = _encoder_and_lines(data)
+    oracle_ws = _windowed(enc)
+    oracle = oracle_ws.feed(lines)
+    oracle.extend(oracle_ws.flush())
+    assert oracle
+    ring = tmp_path_factory.mktemp("drill") / "ring"
+    ws8 = _windowed(
+        enc, shard=spec_for(8),
+        checkpointer=WindowCheckpointer(str(ring), run_id="drill",
+                                        interval_panes=2),
+        fault=FaultPlan({"fold": 5}))
+    with pytest.raises(InjectedFault, match="fold boundary"):
+        ws8.feed(lines)
+    assert os.listdir(ring)
+    return {"enc": enc, "lines": lines, "oracle": oracle, "ring": ring}
+
+
+def _resume_and_compare(drill, tmp_path, shard=None, min_compared=1):
+    """Copy the killed ring, resume under ``shard`` with the gate ON,
+    and assert every post-resume window byte-identical to the unkilled
+    unsharded oracle's."""
+    ring = tmp_path / "ring"
+    shutil.copytree(drill["ring"], ring)
+    ck = WindowCheckpointer(str(ring), run_id="drill", interval_panes=2,
+                            resume=True, reshard=True)
+    ws = _windowed(drill["enc"], shard=shard, checkpointer=ck)
+    skip = ck.restore_into(ws)
+    assert 0 < skip < len(drill["lines"])
+    resumed = ws.feed(drill["lines"][skip:])
+    resumed.extend(ws.flush())
+    assert ws.windows_emitted == len(drill["oracle"])
+    by_index = {w.index: w for w in resumed}
+    compared = 0
+    for want in drill["oracle"]:
+        got = by_index.get(want.index)
+        if got is None:
+            continue
+        np.testing.assert_array_equal(got.results["nb"].bin_counts,
+                                      want.results["nb"].bin_counts)
+        np.testing.assert_array_equal(got.results["nb"].cont_sumsq,
+                                      want.results["nb"].cont_sumsq)
+        assert got.results["mi"].to_lines() == want.results["mi"].to_lines()
+        compared += 1
+    assert compared >= min_compared
+    return ws
+
+
+def test_elastic_restore_kill8_resume4_byte_identical(drill, tmp_path):
+    """The in-process half of the drill: killed on 8, resumed on 4 with
+    the gate ON — every window emitted after the resume byte-identical
+    to the unkilled 1-chip run's."""
+    _resume_and_compare(drill, tmp_path, shard=spec_for(4))
+
+
+def test_elastic_restore_refused_without_gate(drill, tmp_path):
+    """shard.reshard.on.restore defaults OFF: the loud refusal still
+    fires, and it names the gate instead of the foreign-g:-key message."""
+    ring = tmp_path / "ring"
+    shutil.copytree(drill["ring"], ring)
+    ck = WindowCheckpointer(str(ring), run_id="drill",
+                            interval_panes=2, resume=True)
+    assert ck.reshard is False
+    ws4 = _windowed(drill["enc"], shard=spec_for(4), checkpointer=ck)
+    with pytest.raises(ConfigError, match="shard.reshard.on.restore"):
+        ck.restore_into(ws4)
+    # from_conf reads the gate key (default off)
+    conf = JobConfig({"stream.checkpoint.dir": str(tmp_path / "other")})
+    assert WindowCheckpointer.from_conf(conf).reshard is False
+    conf.set("shard.reshard.on.restore", "true")
+    assert WindowCheckpointer.from_conf(conf).reshard is True
+
+
+def test_same_topology_resume_needs_no_gate(drill, tmp_path):
+    """No regression of PR 6/12's proofs: a SAME-topology (8→8) resume
+    loads WITHOUT the gate and reproduces the remaining windows
+    byte-for-byte (vs the unsharded oracle — sharded==unsharded is the
+    proven test_shard.py identity)."""
+    ring = tmp_path / "ring"
+    shutil.copytree(drill["ring"], ring)
+    ck = WindowCheckpointer(str(ring), run_id="drill",
+                            interval_panes=2, resume=True)   # gate OFF
+    ws8 = _windowed(drill["enc"], shard=spec_for(8), checkpointer=ck)
+    skip = ck.restore_into(ws8)
+    resumed = ws8.feed(drill["lines"][skip:])
+    resumed.extend(ws8.flush())
+    by_index = {w.index: w for w in resumed}
+    compared = 0
+    for want in drill["oracle"]:
+        got = by_index.get(want.index)
+        if got is not None:
+            np.testing.assert_array_equal(got.results["nb"].bin_counts,
+                                          want.results["nb"].bin_counts)
+            assert (got.results["mi"].to_lines()
+                    == want.results["mi"].to_lines())
+            compared += 1
+    assert compared >= 1
+
+
+def test_elastic_restore_onto_unsharded_einsum(drill, tmp_path):
+    """Kill on 8, resume UNSHARDED (the CPU einsum routing): the gram is
+    demoted through adopt_state and the stream still reproduces the
+    oracle's windows byte-for-byte — the full shrink-to-one-chip case."""
+    ws1 = _resume_and_compare(drill, tmp_path, shard=None)
+    assert ws1.folder.step == "einsum"
+
+
+def test_routing_crossing_at_same_suffix_is_still_gated(drill, tmp_path):
+    """A kernel-written snapshot (bare gram keys, mesh suffix "") landing
+    on the einsum routing (also suffix "") is STILL a key-family
+    crossing: loading it unadopted would silently drop every post-resume
+    pane's counts from the merged window tables.  The gate triggers on
+    the routing, refuses loudly by default, and adopts exactly under the
+    flag (round-16 review finding)."""
+    # fabricate the TPU-kernel shape of the drill snapshot: same totals,
+    # gram keys stripped to the bare layout key (suffix "")
+    src = ckpt_mod.CheckpointManager(str(drill["ring"]), keep=2)
+    kernel_like, moved = reshard.reshard_state_tree(src.restore(), "")
+    assert moved                        # the drill snapshot was mesh-keyed
+    ring = tmp_path / "ring"
+    dst = ckpt_mod.CheckpointManager(str(ring), keep=2)
+    dst.save(int(kernel_like["pane"]), kernel_like)
+
+    ck = WindowCheckpointer(str(ring), run_id="drill", interval_panes=2,
+                            resume=True)           # gate OFF
+    with pytest.raises(ConfigError, match="routing"):
+        ck.restore_into(_windowed(drill["enc"]))   # einsum target
+    ck2 = WindowCheckpointer(str(ring), run_id="drill", interval_panes=2,
+                             resume=True, reshard=True)
+    ws1 = _windowed(drill["enc"])
+    skip = ck2.restore_into(ws1)
+    resumed = ws1.feed(drill["lines"][skip:])
+    resumed.extend(ws1.flush())
+    by_index = {w.index: w for w in resumed}
+    compared = 0
+    for want in drill["oracle"]:
+        got = by_index.get(want.index)
+        if got is not None:
+            np.testing.assert_array_equal(got.results["nb"].bin_counts,
+                                          want.results["nb"].bin_counts)
+            assert (got.results["mi"].to_lines()
+                    == want.results["mi"].to_lines())
+            compared += 1
+    assert compared >= 1
+
+
+def test_einsum_snapshot_onto_gram_routing_never_silently_folds(
+        drill, tmp_path):
+    """The REVERSE routing crossing: an einsum-written ring (CPU, 'fc'/
+    'pcc<off>' keys) restored onto a gram routing.  This direction is
+    genuinely non-portable (pair tensors outside the persisted union
+    were never aggregated), so the restore must refuse loudly with a
+    message naming the CORRECT direction and a remediation that works —
+    with the gate on OR off — never load silently into the gram-first
+    tables() read-out (round-16 review findings)."""
+    ring = tmp_path / "ring"
+    ws1 = _windowed(
+        drill["enc"],
+        checkpointer=WindowCheckpointer(str(ring), run_id="drill",
+                                        interval_panes=2),
+        fault=FaultPlan({"fold": 5}))
+    assert ws1.folder.step == "einsum"
+    with pytest.raises(InjectedFault):
+        ws1.feed(drill["lines"])
+    assert os.listdir(ring)
+
+    for gate in (False, True):
+        ck = WindowCheckpointer(str(ring), run_id="drill",
+                                interval_panes=2, resume=True,
+                                reshard=gate)
+        with pytest.raises(ConfigError,
+                           match="einsum.*cannot be promoted"):
+            ck.restore_into(_windowed(drill["enc"], shard=spec_for(8)))
+
+
+# ---------------------------------------------------------------------------
+# the conf-driven fault.* family
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_from_conf_and_sites():
+    assert FaultPlan.from_conf(JobConfig({})) is None
+    plan = FaultPlan.from_conf(JobConfig({"fault.fold.crash.after": "2"}))
+    assert plan.schedule == {"fold": 2}
+    plan.hit("fold")
+    with pytest.raises(InjectedFault, match="fold boundary 2"):
+        plan.hit("fold")
+    plan.hit("fold")                       # one-shot: the 3rd hit passes
+    assert plan.faults_fired == 1
+    with pytest.raises(ValueError, match="unknown fault sites"):
+        FaultPlan({"nonsense": 1})
+    with pytest.raises(ValueError, match="unknown fault site"):
+        plan.hit("nope")
+
+
+def test_fault_checkpoint_save_and_restore_sites(drill, tmp_path):
+    plan = FaultPlan({"checkpoint.save": 1})
+    ck = WindowCheckpointer(str(tmp_path / "ring"), run_id="r",
+                            interval_panes=2, fault=plan)
+    ws = _windowed(drill["enc"], shard=spec_for(8), checkpointer=ck)
+    with pytest.raises(InjectedFault, match="checkpoint.save"):
+        ws.feed(drill["lines"])
+    # nothing was written: the injected save-crash fires before any write
+    assert not [n for n in os.listdir(tmp_path / "ring")
+                if n.startswith("step_")]
+    restore_plan = FaultPlan({"checkpoint.restore": 1})
+    with pytest.raises(InjectedFault, match="checkpoint.restore"):
+        WindowCheckpointer(str(tmp_path / "ring"), run_id="r",
+                           resume=True, fault=restore_plan)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: reshard_to + the _recover crash matrix (satellite)
+# ---------------------------------------------------------------------------
+
+def test_manager_restore_reshard_to(tmp_path):
+    mgr = ckpt_mod.CheckpointManager(str(tmp_path / "ck"))
+    g8 = "g:cls:f4:b5:c2:mesh:data8"
+    mgr.save(3, {"run": "rid", "acc": {g8: np.arange(4, dtype=np.int64)}})
+    plain = mgr.restore()
+    assert g8 in plain["acc"]
+    moved = mgr.restore(reshard_to=":mesh:data2")
+    assert "g:cls:f4:b5:c2:mesh:data2" in moved["acc"]
+    np.testing.assert_array_equal(
+        moved["acc"]["g:cls:f4:b5:c2:mesh:data2"], plain["acc"][g8])
+    flat = mgr.restore(reshard_to="")
+    assert "g:cls:f4:b5:c2" in flat["acc"]
+
+
+def test_recover_sweeps_torn_temp_and_duplicate_steps(tmp_path):
+    root = tmp_path / "ck"
+    mgr = ckpt_mod.CheckpointManager(str(root))
+    mgr.save(1, {"run": "r", "x": np.ones(3)})
+    mgr.save(2, {"run": "r", "x": np.full(3, 2.0)})
+    # torn temp dir (a crash mid-save_state) + an orphaned .bak twin of a
+    # LIVE snapshot + an orphaned .bak with NO live twin
+    os.makedirs(root / ".ckpt_torn")
+    (root / ".ckpt_torn" / "state.json").write_text("{trunc")
+    shutil.copytree(root / "step_1", root / "step_1.bak")
+    shutil.copytree(root / "step_2", root / "step_3.bak")
+    shutil.rmtree(root / "step_2")
+    mgr2 = ckpt_mod.CheckpointManager(str(root))
+    names = sorted(os.listdir(root))
+    assert names == ["step_1", "step_3"]          # recovered, deduped
+    assert float(mgr2.restore(1)["x"][0]) == 1.0
+    assert float(mgr2.restore(3)["x"][0]) == 2.0  # promoted .bak
+
+
+def test_torn_snapshot_refuses_never_restores_partial(tmp_path):
+    root = tmp_path / "ck"
+    mgr = ckpt_mod.CheckpointManager(str(root))
+    mgr.save(1, {"run": "r", "w": np.ones(3), "n": 5})
+    # torn payload: structure references arrays the npz no longer holds
+    os.remove(root / "step_1" / "arrays.npz")
+    with pytest.raises(ckpt_mod.CheckpointError, match="refusing"):
+        mgr.restore()
+    mgr.save(2, {"run": "r", "w": np.ones(3), "n": 5})
+    # torn structure: half-written JSON
+    (root / "step_2" / "state.json").write_text('{"run": "r", ')
+    with pytest.raises(ckpt_mod.CheckpointError, match="not valid JSON"):
+        mgr.restore(2)
+
+
+def test_snapshot_deleted_mid_listing_recovers_to_next(tmp_path):
+    """A snapshot that VANISHES between _steps() and the read (a racing
+    retention sweep) must recover to the next-newest intact snapshot —
+    or refuse, never return a partial tree."""
+    root = tmp_path / "ck"
+    mgr = ckpt_mod.CheckpointManager(str(root))
+    mgr.save(1, {"run": "r", "x": np.ones(2)})
+    mgr.save(2, {"run": "r", "x": np.full(2, 2.0)})
+    real_steps = mgr._steps
+
+    def racing_steps():
+        steps = real_steps()
+        if (root / "step_2").exists():
+            shutil.rmtree(root / "step_2")     # vanish AFTER the listing
+        return steps
+
+    mgr._steps = racing_steps
+    state = mgr.restore()
+    assert float(state["x"][0]) == 1.0         # fell back to step_1
+    # an EXPLICIT step that vanished refuses instead of guessing
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(2)
+
+
+# ---------------------------------------------------------------------------
+# jobs layer: StreamCheckpointer refuses/reshards foreign-topology state
+# ---------------------------------------------------------------------------
+
+def _seed_stream_snapshot(directory, suffix=":mesh:data8"):
+    mgr = ckpt_mod.CheckpointManager(str(directory), keep=2)
+    mgr.save(4, {"run": "rid",
+                 "acc": {f"g:cls:f4:b5:c2{suffix}":
+                         np.arange(6, dtype=np.int64),
+                         "class": np.ones(2, np.int64)},
+                 "cursor": {"file": "data.csv", "offset": 100, "chunk": 4},
+                 "rows": 400})
+
+
+def test_stream_checkpointer_refuses_then_reshards(tmp_path):
+    from avenir_tpu.jobs.base import StreamCheckpointer
+
+    _seed_stream_snapshot(tmp_path / "sck")
+    with pytest.raises(ConfigError, match="shard.reshard.on.restore"):
+        StreamCheckpointer(str(tmp_path / "sck"), resume=True,
+                           run_id="rid")
+    ck = StreamCheckpointer(str(tmp_path / "sck"), resume=True,
+                            run_id="rid", reshard=True)
+    assert ck.error is None
+    assert "g:cls:f4:b5:c2" in ck.accumulator.names()
+    assert ck.base_rows == 400 and ck.start["chunk"] == 4
+
+
+# ---------------------------------------------------------------------------
+# run identity: topology is layout, not semantics
+# ---------------------------------------------------------------------------
+
+def test_run_id_excludes_topology_but_not_numerics():
+    from avenir_tpu.jobs.base import StreamCheckpointer
+
+    base = {"feature.schema.file.path": "s.json", "stream.chunk.rows": "64"}
+    rid = StreamCheckpointer.run_id_from_conf(JobConfig(dict(base)))
+    resharded = StreamCheckpointer.run_id_from_conf(JobConfig(
+        {**base, "shard.devices": "4", "shard.data.axis": "data",
+         "shard.reshard.on.restore": "true", "shard.skew.sample": "2",
+         "fault.fold.crash.after": "6"}))
+    assert rid == resharded
+    # semantic keys still change the identity — including the QUANTIZED
+    # collective flag: it changes numerics (lossy int8 beyond the
+    # exactness window), so its totals must never merge with exact ones
+    other = StreamCheckpointer.run_id_from_conf(JobConfig(
+        {**base, "stream.chunk.rows": "128"}))
+    assert other != rid
+    quantized = StreamCheckpointer.run_id_from_conf(JobConfig(
+        {**base, "shard.allreduce.quantized": "true"}))
+    assert quantized != rid
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the CLI renders the drill's durability timeline
+# ---------------------------------------------------------------------------
+
+def test_cli_durability_timeline_renders_reshard_and_faults(tmp_path):
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry import __main__ as cli
+
+    tracer = tel.tracer().enable(str(tmp_path))
+    try:
+        with tracer.span("drill"):
+            plan = FaultPlan({"fold": 1})
+            with pytest.raises(InjectedFault):
+                plan.hit("fold")
+            tracer.event("checkpoint.restore", dir="d", run="rid",
+                         rows=400, chunk=4)
+            reshard.journal_reshard(":mesh:data8", ":mesh:data4", 3,
+                                    directory="d", run="rid")
+        path = tracer.journal_path
+    finally:
+        tel.tracer().disable()
+    from avenir_tpu.telemetry.journal import read_events
+
+    lines = cli.render(read_events(path))
+    text = "\n".join(lines)
+    assert "durability timeline:" in text
+    assert "fault.injected" in text and "site=fold" in text
+    assert ":mesh:data8 -> :mesh:data4 (3 key(s))" in text
+    assert "checkpoint.restore" in text
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE-specified gate: the fresh-subprocess preemption drill
+# ---------------------------------------------------------------------------
+
+def test_preemption_drill_subprocess():
+    """Kill on 8 devices mid-fold (injected ``fault.*``), resume on 4
+    with ``shard.reshard.on.restore=true``, assert byte-identity to the
+    unkilled 1-chip run at WindowedScan AND job level, with the journal
+    events that explain the drill — in a FRESH process that forces the
+    8-device host mesh itself (tests/shard_worker.py discipline)."""
+    worker = os.path.join(os.path.dirname(__file__), "reshard_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, worker], env=env, cwd=repo_root,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "reshard worker ok" in res.stdout

@@ -294,6 +294,16 @@ GOLDEN_EVENT_KEYS = {
     "bench.regression": {"ev", "ts", "trace", "span", "verdict", "compared",
                          "regressed", "skipped", "missing", "baseline"},
     "xla.trace": {"ev", "ts", "trace", "span", "stage", "dir"},
+    # ElasticGraft (round 16): a restore-time topology crossing — the
+    # suffix a snapshot was written under, the one it was redistributed
+    # onto, and how many accumulator entries moved
+    # (checkpoint/reshard.py::journal_reshard) — and the conf-driven
+    # fault family's injected-kill record (utils/retry.py::FaultPlan,
+    # journaled BEFORE the raise so a killed run's journal explains
+    # itself) — docs/observability.md event table
+    "checkpoint.reshard": {"ev", "ts", "trace", "span", "dir", "run",
+                           "src", "dst", "keys"},
+    "fault.injected": {"ev", "ts", "trace", "span", "site", "hit"},
 }
 
 # GraftFleet (round 15): EVERY journaled event additionally carries the
@@ -375,6 +385,15 @@ def test_golden_event_shapes(tmp_path):
             {"verdict": "pass", "compared": 1, "regressed": [],
              "skipped": []}, "BASELINE.json")
         tracer.event("xla.trace", stage="s1", dir="/tmp/xla/s1")
+        # ElasticGraft events (round 16) ride their REAL emission paths:
+        # the reshard journal helper and the fault plan's pre-raise event
+        from avenir_tpu.checkpoint.reshard import journal_reshard
+        from avenir_tpu.utils.retry import FaultPlan, InjectedFault
+
+        journal_reshard(":mesh:data8", ":mesh:data4", 3,
+                        directory="d", run="r")
+        with pytest.raises(InjectedFault):
+            FaultPlan({"fold": 1}).hit("fold")
     path = tracer.journal_path
     tel.tracer().disable()
     seen = {}
