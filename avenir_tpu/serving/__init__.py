@@ -10,6 +10,7 @@ as a pipeline stage.
 
 from avenir_tpu.serving.batcher import BucketedMicrobatcher, PendingRequest
 from avenir_tpu.serving.errors import (
+    ReplicaDownError,
     RequestError,
     RequestTimeout,
     ServingError,
@@ -21,14 +22,16 @@ from avenir_tpu.serving.frontend import (
     ScoreHTTPServer,
     redis_score_frontend,
 )
+from avenir_tpu.serving.pool import PoolRequest, ReplicaPool
 from avenir_tpu.serving.registry import FAMILIES, ModelRegistry, ServableModel
 from avenir_tpu.serving.replay import ScoringPlane
 
 __all__ = [
     "BucketedMicrobatcher", "PendingRequest",
     "ServingError", "UnknownModelError", "ShedError", "RequestTimeout",
-    "RequestError",
+    "RequestError", "ReplicaDownError",
     "QueueScoreFrontend", "ScoreHTTPServer", "redis_score_frontend",
     "FAMILIES", "ModelRegistry", "ServableModel",
+    "ReplicaPool", "PoolRequest",
     "ScoringPlane",
 ]

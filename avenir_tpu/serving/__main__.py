@@ -1,7 +1,11 @@
 """Serving CLI — ``python -m avenir_tpu.serving --conf serve.properties``.
 
 Loads every family in ``serve.models`` from the properties file's artifact
-paths, warms the (model, bucket) compile cache, and serves:
+paths, warms the (model, bucket) compile cache, and serves.  With
+``pool.replicas`` (or ``pool.autoscale.on``) set, the plane is a
+FleetServe :class:`~avenir_tpu.serving.pool.ReplicaPool` — N batcher
+replicas with health-gated routing, breaker/heartbeat failure detection,
+request failover and burn-rate autoscaling — behind the same transports:
 
 - HTTP on ``serve.http.port`` (default 8390): ``POST /score``,
   ``GET /healthz``, ``GET /stats`` — see docs/deployment.md for a
@@ -40,6 +44,7 @@ def main(argv: List[str]) -> int:
         ScoreHTTPServer,
         redis_score_frontend,
     )
+    from avenir_tpu.serving.pool import ReplicaPool
     from avenir_tpu.serving.registry import ModelRegistry
 
     conf = JobConfig.from_file(args.conf)
@@ -52,18 +57,34 @@ def main(argv: List[str]) -> int:
     from avenir_tpu.telemetry.slo import SloEvaluator
 
     tel.configure(conf)
-    registry = ModelRegistry.from_conf(conf)
-    batcher = BucketedMicrobatcher.from_conf(registry, conf)
+    slo = SloEvaluator.from_conf(conf)
+    # FleetServe (round 17): any pool.* arming serves a ReplicaPool — N
+    # batcher replicas with health-gated routing, breaker/heartbeat
+    # failure detection, failover and burn-rate autoscaling — behind the
+    # SAME frontends; without it the plane stays one batcher
+    if conf.get_int("pool.replicas", 0) or \
+            conf.get_bool("pool.autoscale.on", False):
+        # the frontend and the pool's autoscaler share ONE evaluator, so
+        # its violation latch journals one slo.violation per excursion
+        # (the round-15 contract), not one per consumer
+        batcher = ReplicaPool.from_conf(conf, slo=slo)
+        health = batcher.health()
+        names = health["models"]
+        pool_note = f" x{len(health['replicas'])} replicas"
+    else:
+        registry = ModelRegistry.from_conf(conf)
+        batcher = BucketedMicrobatcher.from_conf(registry, conf)
+        names = registry.names()
+        pool_note = ""
     port = (args.http_port if args.http_port is not None
             else conf.get_int("serve.http.port", 8390))
-    slo = SloEvaluator.from_conf(conf)
     http = ScoreHTTPServer(
         batcher, port=port, slo=slo,
         identity=fleet_identity(
             replica=conf.get("trace.writer.suffix"))).start()
-    print(f"serving {registry.names()} on "
+    print(f"serving {names} on "
           f"http://{http.address[0]}:{http.address[1]} "
-          f"(buckets {batcher.buckets})"
+          f"(buckets {batcher.buckets}){pool_note}"
           + (f" with {len(slo.rules)} SLO rule(s)" if slo else ""),
           flush=True)
 

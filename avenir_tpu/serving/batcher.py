@@ -26,17 +26,31 @@ One dispatcher thread owns every device call: the accelerator serializes
 batches anyway, and a single submitter keeps the jit cache and the CUDA/TPU
 stream free of cross-thread interleaving.  ``submit`` may be called from any
 number of frontend threads.
+
+FleetServe (round 17): a batcher is now one REPLICA of a
+:class:`~avenir_tpu.serving.pool.ReplicaPool` — ``name`` labels its spans,
+errors and journal events; ``counters``/``latency`` may be shared across
+the pool so ``/metrics`` aggregates for free; the dispatcher maintains a
+``heartbeat`` the pool's deadline detection reads (:meth:`stalled`); and a
+conf-armed :class:`~avenir_tpu.utils.retry.FaultPlan` can kill it through
+two sites — ``serve.dispatch`` (replica dies mid-batch: every unfinished
+request fails with the retryable :class:`ReplicaDownError`, the pool's
+failover cue) and ``serve.heartbeat`` (the dispatcher wedges silently:
+pending requests stay stranded until the pool's heartbeat deadline reaps
+them) — so chaos drills arm replica loss from configuration alone.
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.serving.errors import (
+    ReplicaDownError,
     RequestError,
     RequestTimeout,
     ServingError,
@@ -46,6 +60,7 @@ from avenir_tpu.serving.registry import ModelRegistry
 from avenir_tpu.telemetry import profile as prof_mod
 from avenir_tpu.telemetry import spans as tel
 from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
+from avenir_tpu.utils.retry import FaultPlan, InjectedFault
 
 
 class PendingRequest:
@@ -54,12 +69,19 @@ class PendingRequest:
     ``trace_ctx`` captures the submitter's span (None with tracing off):
     the dispatch thread can't see the submitting context, so the request's
     span is emitted retroactively with this parent — how a serving request
-    joins the pipeline trace through the ScoringPlane stage."""
+    joins the pipeline trace through the ScoringPlane stage.
+
+    ``rid`` (FleetServe): an optional caller-assigned request id carried
+    into the ``serve.request`` span, so a pool's failover dedupe — "this
+    request scored exactly once, on exactly one replica" — is assertable
+    from the journal.  ``probe`` marks a breaker half-open liveness probe:
+    the dispatcher answers it without scoring (and without counters)."""
 
     __slots__ = ("model", "line", "enqueued", "result", "error", "_done",
-                 "trace_ctx")
+                 "trace_ctx", "rid", "probe")
 
-    def __init__(self, model: str, line: str):
+    def __init__(self, model: str, line: str, rid: Optional[str] = None,
+                 probe: bool = False):
         self.model = model
         self.line = line
         self.enqueued = time.monotonic()
@@ -67,9 +89,16 @@ class PendingRequest:
         self.error: Optional[ServingError] = None
         self._done = threading.Event()
         self.trace_ctx = tel.tracer().current()
+        self.rid = rid
+        self.probe = probe
 
     def finish(self, result: Optional[str] = None,
                error: Optional[ServingError] = None) -> None:
+        # idempotent: a request that already scored must NEVER be
+        # re-finished with a replica-death error (the at-most-once pillar
+        # of pool failover — a done request is done)
+        if self._done.is_set():
+            return
         self.result = result
         self.error = error
         self._done.set()
@@ -91,7 +120,14 @@ class BucketedMicrobatcher:
                  queue_depth: int = 1024,
                  request_timeout_ms: float = 1000.0,
                  warmup: bool = True,
-                 counters: Optional[Counters] = None):
+                 counters: Optional[Counters] = None,
+                 latency: Optional[Dict[str, LatencyTracker]] = None,
+                 name: str = "",
+                 fault: Optional[FaultPlan] = None,
+                 device=None,
+                 on_batch_ok: Optional[Callable[[], None]] = None,
+                 on_batch_error: Optional[Callable[[BaseException],
+                                                   None]] = None):
         self.registry = registry
         self.buckets = sorted({int(b) for b in bucket_sizes})
         if not self.buckets or self.buckets[0] < 1:
@@ -101,8 +137,28 @@ class BucketedMicrobatcher:
         self.queue_depth = max(int(queue_depth), 1)
         self.request_timeout_s = float(request_timeout_ms) / 1e3
         self.counters = counters if counters is not None else Counters()
-        self.latency: Dict[str, LatencyTracker] = {
-            name: LatencyTracker() for name in registry.names()}
+        # ``latency`` may be a POOL-shared dict (FleetServe): every replica
+        # records into the same per-model trackers, so the pool's /metrics
+        # and SLO evaluation aggregate without a merge step
+        self.latency: Dict[str, LatencyTracker] = (
+            latency if latency is not None else {})
+        for model in registry.names():
+            self.latency.setdefault(model, LatencyTracker())
+        # FleetServe replica identity + failure machinery: ``name`` labels
+        # spans/errors/events; ``fault`` is the conf-armed kill schedule
+        # (shared across a pool so site counts are pool-wide); ``device``
+        # pins this replica's dispatches (the dispatcher thread enters
+        # jax.default_device(device) — one replica per local chip);
+        # ``heartbeat`` is the dispatcher's liveness signal, updated every
+        # loop wake and read by ReplicaPool.stalled-based deadline checks
+        self.name = name
+        self.fault = fault
+        self.device = device
+        self.on_batch_ok = on_batch_ok
+        self.on_batch_error = on_batch_error
+        self.heartbeat = time.monotonic()
+        self.failed = False
+        self._dispatching = False
         self._queues: Dict[str, Deque[PendingRequest]] = {
             name: deque() for name in registry.names()}
         # recompile accounting: the shared compile-key diff (telemetry,
@@ -124,13 +180,21 @@ class BucketedMicrobatcher:
         self.ready = False
         if warmup:
             self.warm()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="serve-dispatch")
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serve-dispatch-{name}" if name else "serve-dispatch")
         self._thread.start()
 
     @classmethod
-    def from_conf(cls, registry: ModelRegistry,
-                  conf: JobConfig) -> "BucketedMicrobatcher":
+    def from_conf(cls, registry: ModelRegistry, conf: JobConfig,
+                  **kwargs) -> "BucketedMicrobatcher":
+        """``kwargs`` passes through the FleetServe wiring (``name``,
+        shared ``counters``/``latency``, ``device``, the dispatch
+        callbacks).  A ``fault`` plan not supplied by the caller is armed
+        from the conf's own ``fault.*`` keys, so a single-replica tier-1
+        test kills its batcher through configuration alone."""
+        if "fault" not in kwargs:
+            kwargs["fault"] = FaultPlan.from_conf(conf)
         return cls(
             registry,
             bucket_sizes=conf.get_int_list("serve.bucket.sizes",
@@ -140,6 +204,7 @@ class BucketedMicrobatcher:
             request_timeout_ms=conf.get_float("serve.request.timeout.ms",
                                               1000.0),
             warmup=conf.get_bool("serve.warmup.on.start", True),
+            **kwargs,
         )
 
     # -- warmup / recompile accounting ---------------------------------------
@@ -189,19 +254,23 @@ class BucketedMicrobatcher:
         return version
 
     # -- submission (any thread) ---------------------------------------------
-    def submit_nowait(self, model: str, line: str) -> PendingRequest:
+    def submit_nowait(self, model: str, line: str,
+                      rid: Optional[str] = None) -> PendingRequest:
         entry = self.registry.get(model)            # raises UnknownModelError
         del entry
-        req = PendingRequest(model, line)
+        req = PendingRequest(model, line, rid=rid)
         with self._cond:
+            if self.failed:
+                raise self._down_error("replica is down")
             if self._stop:
                 raise ServingError("batcher is closed")
             queue = self._queues[model]
             if len(queue) >= self.queue_depth:
                 self.counters.increment(f"Serving.{model}", "shed")
-                raise ShedError(
-                    f"{model!r} queue at depth {self.queue_depth} — "
-                    f"request shed (backpressure)")
+                raise self._attribute(ShedError(
+                    f"{model!r} queue at depth {self.queue_depth}"
+                    + (f" on replica {self.name!r}" if self.name else "")
+                    + " — request shed (backpressure)"), wait_s=0.0)
             queue.append(req)
             self._cond.notify()
         return req
@@ -240,33 +309,94 @@ class BucketedMicrobatcher:
         return max(min(deadlines), 0.0)
 
     def _loop(self) -> None:
-        while True:
-            with self._cond:
-                while not self._stop and not self._ready(time.monotonic()):
-                    self._cond.wait(timeout=self._next_wait(time.monotonic()))
-                if self._stop and not any(self._queues.values()):
-                    return
-                ready = ([name for name, q in self._queues.items() if q]
-                         if self._stop else self._ready(time.monotonic()))
-                batches: List[Tuple[str, List[PendingRequest]]] = []
-                for name in ready:
-                    queue = self._queues[name]
-                    take = min(len(queue), self.max_bucket)
-                    batches.append((name,
-                                    [queue.popleft() for _ in range(take)]))
-            for name, reqs in batches:
-                self._dispatch(name, reqs)
+        with contextlib.ExitStack() as stack:
+            if self.device is not None:
+                import jax
+
+                # replica-per-chip placement: every dispatch this thread
+                # makes defaults onto this replica's device (params
+                # committed elsewhere still win — jax array placement)
+                stack.enter_context(jax.default_device(self.device))
+            while True:
+                with self._cond:
+                    self.heartbeat = time.monotonic()
+                    if self.fault is not None:
+                        try:
+                            self.fault.hit("serve.heartbeat")
+                        except InjectedFault:
+                            # the wedged-dispatcher drill: exit WITHOUT
+                            # finishing pending work — the heartbeat goes
+                            # stale and the pool's deadline detection is
+                            # what has to reap the stranded queue
+                            return
+                    while not self._stop and \
+                            not self._ready(time.monotonic()):
+                        self._cond.wait(
+                            timeout=self._next_wait(time.monotonic()))
+                        self.heartbeat = time.monotonic()
+                    if self._stop and not any(self._queues.values()):
+                        return
+                    ready = ([name for name, q in self._queues.items() if q]
+                             if self._stop
+                             else self._ready(time.monotonic()))
+                    batches: List[Tuple[str, List[PendingRequest]]] = []
+                    for name in ready:
+                        queue = self._queues[name]
+                        take = min(len(queue), self.max_bucket)
+                        batches.append((name,
+                                        [queue.popleft()
+                                         for _ in range(take)]))
+                    self._dispatching = True
+                try:
+                    for i, (name, reqs) in enumerate(batches):
+                        # refreshed PER BATCH (lock-free: a float store
+                        # is atomic under the GIL, and the monitor only
+                        # compares staleness) so a dispatcher working
+                        # through several slow batches reads as busy,
+                        # not wedged — only true silence past the
+                        # deadline is a miss
+                        self.heartbeat = time.monotonic()
+                        try:
+                            self._dispatch(name, reqs)
+                        except InjectedFault:
+                            # serve.dispatch kill — replica-fatal: every
+                            # unfinished request (this batch + everything
+                            # queued) fails RETRYABLE so the pool can
+                            # re-enqueue it on a survivor
+                            self._die([r for _, rs in batches[i:]
+                                       for r in rs])
+                            return
+                finally:
+                    with self._cond:
+                        self._dispatching = False
+                        self.heartbeat = time.monotonic()
 
     def _dispatch(self, model: str, reqs: List[PendingRequest]) -> None:
+        scorable = [r for r in reqs if not r.probe]
+        for req in reqs:
+            if req.probe:
+                # breaker half-open liveness probe: answered by the
+                # dispatcher without scoring (and without counters) — it
+                # proves THIS thread is alive and draining its queue
+                req.finish(result="pong")
+        if not scorable:
+            return
+        if self.fault is not None:
+            # the replica-kill site: fires BEFORE any request of the
+            # batch scores (InjectedFault propagates to _loop → _die),
+            # so an injected death can never double-score a request
+            self.fault.hit("serve.dispatch")
         group = f"Serving.{model}"
         now = time.monotonic()
         live: List[PendingRequest] = []
-        for req in reqs:
+        for req in scorable:
             if now - req.enqueued > self.request_timeout_s:
                 self.counters.increment(group, "timeouts")
-                req.finish(error=RequestTimeout(
+                req.finish(error=self._attribute(RequestTimeout(
                     f"request waited past "
-                    f"{self.request_timeout_s * 1e3:.0f} ms before dispatch"))
+                    f"{self.request_timeout_s * 1e3:.0f} ms before dispatch"
+                    + (f" on replica {self.name!r}" if self.name else "")),
+                    wait_s=now - req.enqueued))
             else:
                 live.append(req)
         if not live:
@@ -278,6 +408,11 @@ class BucketedMicrobatcher:
             outs = entry.score_lines([r.line for r in live], bucket)
             dispatch_s = time.monotonic() - t0
         except Exception as exc:
+            # typed ServingErrors are REQUEST faults (bad rows); anything
+            # else is an infrastructure fault the pool's breaker counts
+            if self.on_batch_error is not None and \
+                    not isinstance(exc, ServingError):
+                self.on_batch_error(exc)
             # one bad row must not poison its coalesced batch neighbors:
             # re-score each request alone (smallest bucket — warmed, so no
             # recompile) so only the genuinely bad ones fail typed
@@ -287,8 +422,11 @@ class BucketedMicrobatcher:
             self.counters.increment(group, "errors")
             err = (exc if isinstance(exc, ServingError)
                    else RequestError(f"{type(exc).__name__}: {exc}"))
-            live[0].finish(error=err)
+            live[0].finish(error=self._attribute(
+                err, wait_s=time.monotonic() - live[0].enqueued))
             return
+        if self.on_batch_ok is not None:
+            self.on_batch_ok()
         self._finish_scored(entry, group, model, live, outs, bucket,
                             dispatch_s)
 
@@ -302,11 +440,17 @@ class BucketedMicrobatcher:
             try:
                 outs = entry.score_lines([req.line], bucket)
             except Exception as exc:
+                if self.on_batch_error is not None and \
+                        not isinstance(exc, ServingError):
+                    self.on_batch_error(exc)
                 self.counters.increment(group, "errors")
-                req.finish(error=(exc if isinstance(exc, ServingError)
-                                  else RequestError(
-                                      f"{type(exc).__name__}: {exc}")))
+                err = (exc if isinstance(exc, ServingError)
+                       else RequestError(f"{type(exc).__name__}: {exc}"))
+                req.finish(error=self._attribute(
+                    err, wait_s=time.monotonic() - req.enqueued))
                 continue
+            if self.on_batch_ok is not None:
+                self.on_batch_ok()
             self._finish_scored(entry, group, model, [req], outs, bucket)
 
     def _finish_scored(self, entry, group: str, model: str,
@@ -336,7 +480,16 @@ class BucketedMicrobatcher:
             wait_s = done - req.enqueued
             tracker.record(wait_s)
             if tracer.enabled:
-                attrs = {"model": model, "bucket": bucket}
+                # FleetServe attribution: which replica scored this
+                # request and how long it sat queued — a shed storm or
+                # p99 excursion is triaged to ONE replica from the
+                # merged fleet journal
+                attrs = {"model": model, "bucket": bucket,
+                         "wait_ms": round(wait_s * 1e3, 3)}
+                if self.name:
+                    attrs["replica"] = self.name
+                if req.rid is not None:
+                    attrs["rid"] = req.rid
                 if pid is not None:
                     attrs["program"] = pid
                 tracer.emit_span("serve.request", wait_s,
@@ -346,6 +499,109 @@ class BucketedMicrobatcher:
         self.counters.increment(group, f"bucket.{bucket}")
         if tracer.enabled:
             tracer.gauge(f"serve.queue.{model}", len(self._queues[model]))
+
+    # -- replica failure machinery (FleetServe, round 17) --------------------
+    def _attribute(self, err: ServingError,
+                   wait_s: Optional[float] = None) -> ServingError:
+        """Stamp a typed error with this replica's identity and the
+        request's queue wait, so client-visible failures triage to the
+        replica that caused them without the journal."""
+        err.replica = self.name or None
+        if wait_s is not None:
+            err.queue_wait_ms = round(wait_s * 1e3, 3)
+        return err
+
+    def _down_error(self, reason: str,
+                    req: Optional[PendingRequest] = None) -> ReplicaDownError:
+        err = ReplicaDownError(
+            (f"replica {self.name!r}: " if self.name else "") + reason)
+        return self._attribute(
+            err, wait_s=(time.monotonic() - req.enqueued)
+            if req is not None else None)
+
+    def _die(self, stranded: List[PendingRequest]) -> None:
+        """serve.dispatch kill: mark the replica failed (new submissions
+        are refused at the door) and fail every unfinished request —
+        ``stranded`` (popped but unscored) plus everything still queued —
+        with the RETRYABLE :class:`ReplicaDownError`, the pool's cue to
+        re-enqueue them on survivors.  ``finish`` is idempotent, so a
+        request that already scored can never be re-failed here."""
+        with self._cond:
+            self.failed = True
+            queued = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            self._cond.notify_all()
+        for req in stranded + queued:
+            req.finish(error=self._down_error("died mid-batch", req))
+
+    def mark_failed(self) -> None:
+        """Pool-side declaration that this replica is dead (missed
+        heartbeat deadline): refuse new submissions from now on."""
+        with self._cond:
+            self.failed = True
+            self._cond.notify_all()
+
+    def fail_pending(self, reason: str = "replica down") -> int:
+        """Fail every QUEUED request with :class:`ReplicaDownError` (the
+        pool reaps a wedged replica's stranded queue with this); returns
+        how many requests were failed over."""
+        with self._cond:
+            reqs = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+        for req in reqs:
+            req.finish(error=self._down_error(reason, req))
+        return len(reqs)
+
+    def stalled(self, deadline_s: float) -> bool:
+        """True when the dispatcher has WORK but its heartbeat is older
+        than ``deadline_s`` — a wedged (or silently dead) dispatcher.
+        An idle batcher is never stalled: with nothing to dispatch a
+        stale heartbeat is just sleep."""
+        with self._cond:
+            busy = self._dispatching or any(self._queues.values())
+            return busy and \
+                (time.monotonic() - self.heartbeat) > float(deadline_s)
+
+    def probe(self, timeout_s: float = 5.0) -> bool:
+        """Breaker half-open liveness probe: push a no-op request through
+        the REAL dispatch queue and wait for the dispatcher to answer it.
+        True = the dispatch thread is alive and draining (the breaker may
+        close); False = dead, wedged, or closed (stay open)."""
+        if self.failed or not self._thread.is_alive():
+            return False
+        model = next(iter(self._queues), None)
+        if model is None:
+            return False
+        req = PendingRequest(model, "", rid="probe", probe=True)
+        with self._cond:
+            if self._stop or self.failed:
+                return False
+            self._queues[model].append(req)
+            self._cond.notify()
+        try:
+            req.wait(timeout_s)
+            return True
+        except ServingError:
+            return False
+
+    def health(self) -> Dict[str, object]:
+        """The ``/healthz`` body: readiness (warmed AND not failed),
+        loaded models, per-model queue depth vs cap, and each model's
+        registry version — what a prober needs to see backpressure and
+        rollout state at a glance."""
+        ready = bool(self.ready) and not self.failed
+        return {
+            "status": "ok" if ready else "unavailable",
+            "ready": ready,
+            "models": self.registry.names(),
+            "buckets": self.buckets,
+            "queue": {name: {"depth": depth, "cap": self.queue_depth}
+                      for name, depth in self.queue_depths().items()},
+            "versions": {name: self.registry.version(name)
+                         for name in self.registry.names()},
+        }
 
     # -- observability / shutdown --------------------------------------------
     def stats(self, identity: Optional[Dict[str, str]] = None
@@ -361,13 +617,17 @@ class BucketedMicrobatcher:
             return {name: len(q) for name, q in self._queues.items()}
 
     def close(self) -> None:
-        """Flush every pending request, then stop the dispatcher."""
+        """Flush every pending request, then stop the dispatcher.  A
+        dead/wedged dispatcher cannot flush — its leftovers fail typed
+        (:class:`ReplicaDownError`) instead of hanging their callers."""
         with self._cond:
             if self._stop:
                 return
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout=60.0)
+        if self.fail_pending("batcher closed with a dead dispatcher"):
+            self.failed = True
 
     def __enter__(self) -> "BucketedMicrobatcher":
         return self

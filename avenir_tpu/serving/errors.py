@@ -41,3 +41,13 @@ class RequestError(ServingError):
     unknown sequence symbol, sequence longer than the padded length, ...)."""
 
     code = "BAD_REQUEST"
+
+
+class ReplicaDownError(ServingError):
+    """The replica holding this request died (injected kill, crashed
+    dispatcher, missed heartbeat deadline) before the request scored.
+    RETRYABLE by construction: a request only carries this error if its
+    score never completed, so the pool may re-enqueue it on a survivor
+    without risking a double score (``serving/pool.py`` failover)."""
+
+    code = "REPLICA_DOWN"

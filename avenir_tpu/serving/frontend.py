@@ -27,6 +27,7 @@ from typing import List, Optional, Tuple
 
 from avenir_tpu.serving.batcher import BucketedMicrobatcher, PendingRequest
 from avenir_tpu.serving.errors import (
+    ReplicaDownError,
     RequestError,
     RequestTimeout,
     ServingError,
@@ -38,6 +39,7 @@ _HTTP_STATUS = {
     UnknownModelError: 404,
     ShedError: 429,
     RequestTimeout: 504,
+    ReplicaDownError: 503,
     RequestError: 400,
 }
 
@@ -46,8 +48,24 @@ def _status_for(err: ServingError) -> int:
     return _HTTP_STATUS.get(type(err), 500)
 
 
+def _error_body(err: ServingError) -> dict:
+    """Typed error → JSON body, carrying the FleetServe attribution the
+    batcher stamps (which replica shed/timed out this request and how
+    long it waited) so a shed storm triages from client logs alone."""
+    body = {"error": err.code, "message": str(err)}
+    replica = getattr(err, "replica", None)
+    if replica:
+        body["replica"] = replica
+    wait_ms = getattr(err, "queue_wait_ms", None)
+    if wait_ms is not None:
+        body["queue_wait_ms"] = wait_ms
+    return body
+
+
 class ScoreHTTPServer:
-    """Threaded HTTP front end over a :class:`BucketedMicrobatcher`.
+    """Threaded HTTP front end over a :class:`BucketedMicrobatcher` — or,
+    FleetServe (round 17), a :class:`~avenir_tpu.serving.pool.ReplicaPool`
+    (same duck-typed surface: submit/queue_depths/counters/latency/health).
 
     Concurrent POSTs are the microbatching win: each handler thread submits
     its rows and blocks, and the dispatcher folds every model's concurrent
@@ -104,6 +122,11 @@ class ScoreHTTPServer:
                     gauges = {f"serve.queue.{name}": float(depth)
                               for name, depth in depths.items()}
                     gauges["uptime.sec"] = time.monotonic() - outer.started
+                    # FleetServe: a ReplicaPool adds its readiness and
+                    # per-replica queue gauges to the same scrape page
+                    pool_gauges = getattr(outer.batcher, "gauges", None)
+                    if callable(pool_gauges):
+                        gauges.update(pool_gauges())
                     body = prometheus_text(
                         counters=outer.batcher.counters,
                         latency=outer.batcher.latency,
@@ -128,26 +151,19 @@ class ScoreHTTPServer:
                     # readiness probe (round 15): 503 until every model is
                     # loaded AND its (model, bucket) shapes are warmed —
                     # what a load balancer in front of a replica pool
-                    # needs before routing traffic here.  The body
-                    # reports queue depth vs cap and each model's
-                    # last-swap version, so the prober can also see
-                    # backpressure and rollout state at a glance.
-                    ready = bool(getattr(outer.batcher, "ready", True))
-                    registry = outer.batcher.registry
-                    depths = outer.batcher.queue_depths()
-                    self._send(200 if ready else 503, {
-                        "status": "ok" if ready else "unavailable",
-                        "ready": ready,
-                        "models": registry.names(),
-                        "buckets": outer.batcher.buckets,
-                        "queue": {
-                            name: {"depth": depth,
-                                   "cap": outer.batcher.queue_depth}
-                            for name, depth in depths.items()},
-                        "versions": {name: registry.version(name)
-                                     for name in registry.names()},
-                        "uptime_sec": round(
-                            time.monotonic() - outer.started, 3)})
+                    # needs before routing traffic here.  The body comes
+                    # from the serving plane's own ``health()``: queue
+                    # depth vs cap and per-model versions always; behind
+                    # a ReplicaPool (FleetServe, round 17) it's the
+                    # AGGREGATE — green iff ≥ 1 replica is ready — plus
+                    # one row per replica (ready, breaker state, queue
+                    # depth vs cap, registry version), so a rolling swap
+                    # or a tripped breaker is visible from one curl.
+                    body = outer.batcher.health()
+                    body["uptime_sec"] = round(
+                        time.monotonic() - outer.started, 3)
+                    ready = bool(body.get("ready"))
+                    self._send(200 if ready else 503, body)
                 elif self.path == "/stats":
                     self._send(200,
                                outer.batcher.stats(identity=outer.identity))
@@ -176,8 +192,7 @@ class ScoreHTTPServer:
                 try:
                     results = outer.score_rows(model, rows)
                 except ServingError as err:
-                    self._send(_status_for(err),
-                               {"error": err.code, "message": str(err)})
+                    self._send(_status_for(err), _error_body(err))
                     return
                 self._send(200, {"model": model, "results": results})
 

@@ -10,8 +10,10 @@ Subcommands (the bare ``<journal>`` form keeps rendering the span tree):
   deltas between successive snapshots of the same scope, a durability
   timeline (checkpoint saves/restores, ElasticGraft ``checkpoint.reshard``
   topology crossings, ``fault.injected`` drill kills — the preemption
-  story in time order, round 16), and a one-line tally of the free
-  events (checkpoints, recompiles, gauges, canaries).
+  story in time order, round 16 — and the FleetServe pool lifecycle:
+  ``pool.replica.down``/``up``, ``pool.scale``, round 17), and a
+  one-line tally of the free events (checkpoints, recompiles, gauges,
+  canaries).
   A merged fleet view (≥ 2 writers) attributes every span to its writer
   (``proc=…``/``replica=…``).
 - ``merge <dir>`` — GraftFleet federation (round 15): time-order one
@@ -174,9 +176,11 @@ def counter_deltas(events: List[dict]) -> List[str]:
 
 def durability_lines(events: List[dict]) -> List[str]:
     """The run's durability timeline (round 16): checkpoint lifecycle,
-    ElasticGraft topology crossings and injected drill faults, in journal
-    order — `kill → fault.injected → restore → checkpoint.reshard` reads
-    straight down, which is how a preemption drill is triaged."""
+    ElasticGraft topology crossings, injected drill faults and — round
+    17 — the FleetServe replica-pool lifecycle, in journal order —
+    `fault.injected → pool.replica.down → pool.failover → pool.scale`
+    reads straight down, which is how a replica loss is triaged
+    (docs/runbooks/replica_loss_triage.md)."""
     out: List[str] = []
     for e in events:
         ev = e.get("ev")
@@ -193,6 +197,17 @@ def durability_lines(events: List[dict]) -> List[str]:
         elif ev == "fault.injected":
             out.append(f"  {ev:<20} site={e.get('site', '?')} "
                        f"hit={e.get('hit', '?')}")
+        elif ev in ("pool.replica.down", "pool.replica.up"):
+            pending = (f" pending={e['pending']}"
+                       if e.get("pending") else "")
+            out.append(f"  {ev:<20} replica={e.get('replica', '?')} "
+                       f"reason={e.get('reason', '?')}{pending}")
+        elif ev == "pool.scale":
+            out.append(f"  {ev:<20} {e.get('direction', '?')} -> "
+                       f"{e.get('ready', '?')} ready "
+                       f"(burn={e.get('burn', '?')} "
+                       f"queue_frac={e.get('queue_frac', '?')} "
+                       f"reason={e.get('reason', '?')})")
     return out
 
 

@@ -213,15 +213,25 @@ class FaultPlan:
     - ``fault.checkpoint.save.crash.after`` — raise on the N-th snapshot
       save, BEFORE anything is written (the save must stay atomic);
     - ``fault.checkpoint.restore.crash.after`` — raise on the N-th
-      restore attempt (a worker preempted while coming back up).
+      restore attempt (a worker preempted while coming back up);
+    - ``fault.serve.dispatch.crash.after`` — raise on the N-th serving
+      batch dispatch, BEFORE any request of the batch scores (FleetServe
+      round 17: the batcher treats it as replica-fatal — the whole
+      replica dies mid-batch and its in-flight requests fail over);
+    - ``fault.serve.heartbeat.crash.after`` — wedge the serving
+      dispatcher on its N-th loop wake: the thread exits WITHOUT
+      finishing pending work, so the replica's heartbeat goes stale and
+      the pool's deadline detection is what has to catch it.
 
     Each firing journals a golden-schema'd ``fault.injected`` event
     (site, 1-based hit number) so the run's trace explains the drill.
-    Counts are per-plan-instance; build one plan per run seam
-    (``from_conf`` returns None when no ``fault.*`` key is armed — the
-    zero-cost default)."""
+    Counts are per-plan-instance; build one plan per run seam — a
+    replica POOL shares one plan across its replicas, so "kill the N-th
+    dispatch" means the N-th dispatch pool-wide (``from_conf`` returns
+    None when no ``fault.*`` key is armed — the zero-cost default)."""
 
-    SITES = ("fold", "checkpoint.save", "checkpoint.restore")
+    SITES = ("fold", "checkpoint.save", "checkpoint.restore",
+             "serve.dispatch", "serve.heartbeat")
 
     def __init__(self, schedule: Dict[str, int]):
         unknown = set(schedule) - set(self.SITES)
@@ -243,6 +253,10 @@ class FaultPlan:
                 conf.get_int("fault.checkpoint.save.crash.after", 0) or 0,
             "checkpoint.restore":
                 conf.get_int("fault.checkpoint.restore.crash.after", 0) or 0,
+            "serve.dispatch":
+                conf.get_int("fault.serve.dispatch.crash.after", 0) or 0,
+            "serve.heartbeat":
+                conf.get_int("fault.serve.heartbeat.crash.after", 0) or 0,
         }
         plan = cls(sched)
         return plan if plan.schedule else None

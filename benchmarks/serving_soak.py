@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""FleetServe chaos soak: millions-of-users-shaped traffic against a
+:class:`~avenir_tpu.serving.pool.ReplicaPool`, with failure as the tested
+path — a fault-injected replica KILL and a rolling hot-swap both land
+mid-soak, and acceptance is a ``telemetry slo`` exit 0 plus journal-proved
+request accounting.
+
+The traffic shape models the north-star claim in miniature: bursty
+arrivals (a repeating burst-size pattern, not a constant rate), mixed
+model families sharing one pool (naiveBayes + logistic over the churn
+schema), and closed-loop clients (each burst waits before the next — how
+real user fan-in backs off).  Mid-soak:
+
+- a **rolling hot-swap** republishes a retrained naiveBayes artifact
+  through the round-11 warmup barrier one replica at a time (capacity
+  never zero, zero steady-state recompiles across the rollout);
+- a **replica kill** fires through the conf-armed
+  ``fault.serve.dispatch.crash.after`` site (utils/retry.FaultPlan — no
+  monkeypatching): the replica dies mid-batch, its in-flight requests
+  fail over to survivors, and the burn-rate autoscaler replaces the lost
+  capacity (``pool.autoscale.min``).
+
+Acceptance, all machine-checked:
+
+- ``python -m avenir_tpu.telemetry slo`` exit 0 over the merged fleet
+  journal: p99-under-burst, shed-rate, and ``recompiles.total == 0``
+  (the ``steady_state_recompiles_total`` invariant) rules;
+- ``pool.replica.down`` / ``pool.scale`` / ``fault.injected`` events
+  present in the merged journal;
+- ZERO lost and ZERO double-scored requests, asserted from the journal's
+  per-request ``serve.request`` spans (each carries its pool ``rid``):
+  every client-visible success maps to exactly one scored span, and
+  every submitted request has exactly one outcome (a scored line or one
+  typed error).
+
+One JSON artifact line on stdout; a fresh matmul canary rides in it per
+the PR-2 convention (a loaded rig indicts itself, not the pool).
+"""
+
+import glob
+import json
+import os
+import tempfile
+import time
+
+# the burst-size pattern: heavy/light alternation so queue depth (and the
+# p99 the SLO gates) is measured under BURSTS, not a polite constant rate
+BURST_PATTERN = (32, 8, 48, 16, 40, 4)
+
+
+def _train_workspace(root):
+    """Train the two serving artifacts (naiveBayes v1+v2, logistic) with
+    the real jobs over the churn generator — the same artifact-handoff
+    path production serving uses."""
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+
+    j = lambda *p: os.path.join(root, *p)
+    rows = generate_churn(1400, seed=7)
+    write_csv(j("train.csv"), rows[:900])
+    write_csv(j("test.csv"), rows[900:])
+    write_csv(j("train2.csv"), generate_churn(900, seed=23))  # the retrain
+    with open(j("churn.json"), "w") as fh:
+        fh.write(json.dumps(CHURN_SCHEMA_JSON))
+    churn = {"feature.schema.file.path": j("churn.json")}
+    get_job("BayesianDistribution").run(JobConfig(dict(churn)),
+                                        j("train.csv"), j("nb_model"))
+    get_job("BayesianDistribution").run(JobConfig(dict(churn)),
+                                        j("train2.csv"), j("nb_model_v2"))
+    get_job("LogisticRegressionJob").run(
+        JobConfig({**churn, "coeff.file.path": j("coeff.txt"),
+                   "iteration.limit": "10"}),
+        j("train.csv"), j("lr_out"))
+    return churn, read_lines(j("test.csv"))
+
+
+def run_soak(bursts=48, replicas=2, p99_target_ms=2000.0,
+             shed_target=0.02, scale=1.0, canary=True):
+    """The soak body; ``scale`` shrinks the burst pattern and
+    ``canary=False`` skips the rig canary (the tier-1 smoke runs a
+    miniature soak through the identical failure path — it pins
+    correctness, not rig speed, and a chained 4096³ matmul on a CI CPU
+    is most of a minute).  Returns the artifact dict; raises
+    RuntimeError on any gate failure."""
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.serving.errors import ServingError
+    from avenir_tpu.serving.pool import ReplicaPool
+    from avenir_tpu.serving.registry import NaiveBayesServable
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry.__main__ import main as telemetry_cli
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
+
+    root = tempfile.mkdtemp(prefix="serving_soak_")
+    churn, lines = _train_workspace(root)
+    pattern = [max(int(b * scale), 2) for b in BURST_PATTERN]
+    total_requests = sum(pattern[b % len(pattern)] for b in range(bursts))
+    # the kill lands mid-soak: total dispatches >= requests/max_bucket,
+    # so this count is guaranteed to be reached before traffic ends
+    kill_after = max(2, total_requests // 16)
+    j = lambda *p: os.path.join(root, *p)
+    props = {
+        **churn,
+        "bayesian.model.file.path": j("nb_model"),
+        "coeff.file.path": j("coeff.txt"),
+        "serve.models": "naiveBayes,logistic",
+        "serve.bucket.sizes": "1,2,4,8",
+        "serve.flush.deadline.ms": "4",
+        "serve.queue.depth": "256",
+        "serve.request.timeout.ms": "20000",
+        # the pool: N replicas, fast supervision, failover armed, and the
+        # autoscaler replacing lost capacity from the burn/queue gauges
+        "pool.replicas": str(replicas),
+        "pool.heartbeat.ms": "500",
+        "pool.monitor.interval.ms": "40",
+        "pool.failover.retries": "1",
+        "pool.autoscale.on": "true",
+        "pool.autoscale.min": str(replicas),
+        "pool.autoscale.max": str(replicas + 1),
+        "pool.autoscale.interval.sec": "0.2",
+        # the chaos: kill a replica mid-batch through conf alone
+        "fault.serve.dispatch.crash.after": str(kill_after),
+        # the observability plane the acceptance reads
+        "trace.on": "true",
+        "trace.journal.dir": root,
+        "trace.run.id": "fleetsoak",
+        # the SLO gate `telemetry slo` closes on
+        "slo.p99.metric": "p99.latency.ms",
+        "slo.p99.target": str(p99_target_ms),
+        "slo.shed.metric": "shed.rate",
+        "slo.shed.target": str(shed_target),
+        "slo.recompiles.metric": "recompiles.total",
+        "slo.recompiles.target": "0",
+    }
+    conf_path = j("soak.properties")
+    with open(conf_path, "w") as fh:
+        fh.write("\n".join(f"{k}={v}" for k, v in props.items()) + "\n")
+    conf = JobConfig.from_file(conf_path)
+    tel.configure(conf)
+    canary_ms = matmul_canary_ms() if canary else None
+    pool = ReplicaPool.from_conf(conf)
+
+    models = ("naiveBayes", "logistic")
+    outcomes = {}
+    door_shed = 0
+    swap_at = bursts // 2
+    swapped_versions = None
+    burst_lat = []
+    t0 = time.perf_counter()
+    for b in range(bursts):
+        size = pattern[b % len(pattern)]
+        batch = []
+        tb = time.perf_counter()
+        for i in range(size):
+            model = models[(b + i) % len(models)]
+            line = lines[(b * size + i) % len(lines)]
+            try:
+                batch.append(pool.submit_nowait(model, line))
+            except ServingError:
+                door_shed += 1            # typed refusal at the door
+        for req in batch:
+            try:
+                outcomes[req.rid] = ("ok", req.wait(60.0))
+            except ServingError as err:
+                outcomes[req.rid] = (err.code, None)
+        burst_lat.append(time.perf_counter() - tb)
+        if b == swap_at:
+            # mid-soak rolling hot-swap: retrained NB, one replica at a
+            # time through the warmup barrier — capacity never zero
+            entry = NaiveBayesServable.from_conf(JobConfig(
+                {**churn, "bayesian.model.file.path": j("nb_model_v2")}))
+            swapped_versions = pool.swap("naiveBayes", entry)
+    soak_s = time.perf_counter() - t0
+    # let the supervisor finish reaping AND replacing before the books
+    # close: a short soak can outrun the autoscale tick, and the
+    # replacement's pool.scale/pool.replica.up events are acceptance
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline and \
+            pool.stats()["pool"]["ready"] < replicas:
+        time.sleep(0.05)
+    recompiles = sum(
+        vals.get("recompiles", 0)
+        for group, vals in pool.counters.as_dict().items()
+        if group.startswith("Serving."))
+    pool_stats = pool.stats()["pool"]
+    health = pool.health()
+    # final counter snapshot into the journal: the post-hoc SLO gate's
+    # shed.rate / recompiles.total metrics read it
+    tel.tracer().counters("serving", pool.counters)
+    pool.close()
+    tel.tracer().disable()
+
+    # -- the merged fleet journal is the acceptance artifact ------------------
+    rc_merge = telemetry_cli(["merge", root])
+    fleet = sorted(glob.glob(j("fleet-*.jsonl")))
+    if rc_merge != 0 or not fleet:
+        raise RuntimeError(f"journal merge failed (rc={rc_merge})")
+    from avenir_tpu.telemetry.journal import read_events
+
+    events = read_events(fleet[-1])
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e["ev"], []).append(e)
+    for required in ("fault.injected", "pool.replica.down", "pool.scale"):
+        if required not in by_ev:
+            raise RuntimeError(
+                f"chaos soak journal carries no {required!r} event — the "
+                f"drill did not exercise the failure path")
+    # zero lost, zero double-scored — from the journal's own spans
+    scored = {}
+    for e in by_ev.get("span.close", []):
+        if e.get("name") != "serve.request":
+            continue
+        rid = (e.get("attrs") or {}).get("rid")
+        if rid:
+            scored[rid] = scored.get(rid, 0) + 1
+    doubles = {rid: n for rid, n in scored.items() if n > 1}
+    ok_rids = {rid for rid, (code, _) in outcomes.items() if code == "ok"}
+    if doubles:
+        raise RuntimeError(f"double-scored requests: {doubles}")
+    if set(scored) != ok_rids:
+        raise RuntimeError(
+            f"journal/client disagree: {len(scored)} scored spans vs "
+            f"{len(ok_rids)} client successes")
+    lost = [rid for rid in outcomes if outcomes[rid][0] not in
+            ("ok", "SHED", "TIMEOUT", "REPLICA_DOWN", "BAD_REQUEST")]
+    if lost:
+        raise RuntimeError(f"requests with untyped outcomes: {lost[:5]}")
+
+    # -- the `telemetry slo` gate: exit 0 is the acceptance -------------------
+    rc_slo = telemetry_cli(["slo", fleet[-1], "--conf", conf_path])
+    shed = sum(1 for code, _ in outcomes.values() if code == "SHED")
+    shed += door_shed
+    artifact = {
+        "benchmark": "serving_soak",
+        "canary_ms": round(canary_ms, 3) if canary_ms is not None else None,
+        "requests": total_requests,
+        "bursts": bursts,
+        "ok": len(ok_rids),
+        "shed": shed,
+        "door_shed": door_shed,
+        "failovers": pool_stats.get("failovers", 0),
+        "replicas_lost": pool_stats.get("replicas.lost", 0),
+        "replicas_final": pool_stats.get("replicas", 0),
+        "events_per_sec": round(total_requests / soak_s, 1),
+        "burst_p99_ms": round(
+            sorted(burst_lat)[int(0.99 * (len(burst_lat) - 1))] * 1e3, 2),
+        "swap_versions": swapped_versions,
+        "pool_events": {ev: len(by_ev.get(ev, []))
+                        for ev in ("pool.replica.down", "pool.replica.up",
+                                   "pool.scale", "pool.failover",
+                                   "fault.injected")},
+        "steady_state_recompiles_total": int(recompiles),
+        "slo_exit": rc_slo,
+        "healthz_ready": bool(health["ready"]),
+    }
+    if recompiles != 0:
+        raise RuntimeError(
+            f"steady_state_recompiles_total={recompiles}: a shape escaped "
+            f"the warmed bucket set (or the swap barrier was skipped)")
+    if swapped_versions is None or \
+            any(v < 2 for v in swapped_versions.values()):
+        raise RuntimeError(
+            f"rolling hot-swap never advanced every live replica: "
+            f"{swapped_versions}")
+    if rc_slo != 0:
+        raise RuntimeError(
+            f"telemetry slo exited {rc_slo} — the soak violated an SLO "
+            f"rule (see verdict above)")
+    return artifact
+
+
+def main():
+    print(json.dumps(run_soak()))
+
+
+if __name__ == "__main__":
+    main()

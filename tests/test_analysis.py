@@ -381,6 +381,12 @@ def test_whole_tree_zero_nonbaselined_findings():
     # the ElasticGraft preemption drill drives checkpoint save/restore/
     # reshard loops, where an undocumented shard.reshard.*/fault.* key
     # (GL004) or an unfingerprinted snapshot (GL002) would hide
+    # tests/test_pool.py likewise (round 17) — the FleetServe tests
+    # drive pool routing/failover/autoscale loops, where an undocumented
+    # pool.*/fault.serve.* key (GL004) or a sync-in-loop around the
+    # burst timing (GL005) would hide (serving/pool.py itself sits
+    # inside the avenir_tpu tree; benchmarks/serving_soak.py inside the
+    # benchmarks tree the gate already walks)
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
@@ -393,7 +399,8 @@ def test_whole_tree_zero_nonbaselined_findings():
          str(REPO / "tests" / "test_fleet.py"),
          str(REPO / "tests" / "fleet_worker.py"),
          str(REPO / "tests" / "test_reshard.py"),
-         str(REPO / "tests" / "reshard_worker.py")],
+         str(REPO / "tests" / "reshard_worker.py"),
+         str(REPO / "tests" / "test_pool.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

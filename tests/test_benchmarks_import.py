@@ -46,6 +46,27 @@ def test_bench_sentinel_wiring_importable():
     )["verdict"] == "no_baseline"
 
 
+def test_serving_soak_smoke():
+    """A miniature FleetServe chaos soak through the IDENTICAL code path
+    the dev-rig benchmark runs (round 17): bursty mixed-model traffic
+    against a 2-replica pool, a conf-armed mid-soak replica kill, a
+    rolling hot-swap, the autoscaler replacing the lost capacity, and
+    the `telemetry slo` exit-0 gate over the merged journal — plus the
+    zero-lost / zero-double-scored accounting run_soak itself asserts.
+    Generous p99 target: the smoke pins CORRECTNESS of the failure path
+    on a shared CI rig, not rig speed (the benchmark pins that)."""
+    from benchmarks.serving_soak import run_soak
+
+    artifact = run_soak(bursts=6, scale=0.12, p99_target_ms=60_000.0,
+                        shed_target=0.2, canary=False)
+    assert artifact["slo_exit"] == 0
+    assert artifact["steady_state_recompiles_total"] == 0
+    assert artifact["replicas_lost"] == 1
+    assert artifact["pool_events"]["pool.replica.down"] >= 1
+    assert artifact["pool_events"]["pool.scale"] >= 1
+    assert artifact["ok"] + artifact["shed"] == artifact["requests"]
+
+
 def test_benchmarks_lint_clean():
     from avenir_tpu.analysis import engine
 

@@ -304,6 +304,19 @@ GOLDEN_EVENT_KEYS = {
     "checkpoint.reshard": {"ev", "ts", "trace", "span", "dir", "run",
                            "src", "dst", "keys"},
     "fault.injected": {"ev", "ts", "trace", "span", "site", "hit"},
+    # FleetServe (round 17): the replica pool's lifecycle — a replica
+    # leaving rotation (died / heartbeat / breaker / scale.down, with how
+    # many stranded requests were failed over), a replica entering it
+    # (start / probe / replace / scale-up), an autoscaler decision over
+    # the burn/queue gauges, and one request's failover hop — the events
+    # docs/runbooks/replica_loss_triage.md walks (serving/pool.py)
+    "pool.replica.down": {"ev", "ts", "trace", "span", "replica",
+                          "reason", "pending"},
+    "pool.replica.up": {"ev", "ts", "trace", "span", "replica", "reason"},
+    "pool.scale": {"ev", "ts", "trace", "span", "direction", "ready",
+                   "total", "burn", "queue_frac", "reason"},
+    "pool.failover": {"ev", "ts", "trace", "span", "rid", "model",
+                      "from", "to", "attempt"},
 }
 
 # GraftFleet (round 15): EVERY journaled event additionally carries the
@@ -394,6 +407,18 @@ def test_golden_event_shapes(tmp_path):
                         directory="d", run="r")
         with pytest.raises(InjectedFault):
             FaultPlan({"fold": 1}).hit("fold")
+        # FleetServe pool lifecycle events (round 17): shapes pinned via
+        # the same tracer.event form the pool emits them with
+        # (serving/pool.py; the REAL producer paths — kill, wedge,
+        # autoscale, failover — are exercised in tests/test_pool.py with
+        # journal assertions on these exact events)
+        tracer.event("pool.replica.down", replica="r0", reason="died",
+                     pending=4)
+        tracer.event("pool.replica.up", replica="r2", reason="replace")
+        tracer.event("pool.scale", direction="up", ready=2, total=2,
+                     burn=1.4, queue_frac=0.6, reason="burn")
+        tracer.event("pool.failover", rid="q7", model="naiveBayes",
+                     **{"from": "r0", "to": "r1"}, attempt=1)
     path = tracer.journal_path
     tel.tracer().disable()
     seen = {}
