@@ -106,9 +106,17 @@ class Job:
     name: str = ""
 
     def run(self, conf: JobConfig, input_path: str, output_path: str) -> Counters:
+        from avenir_tpu import tenancy
         from avenir_tpu.telemetry import spans as tel
 
         tracer = tel.configure(conf)
+        # GraftPool (round 18): a standalone job is a tenant workload too
+        # — arm the arbiter from tenant.* contracts (no-op without them)
+        # and run under the conf's tenant label, so its chunk folds draw
+        # arbitrated dispatch slots and its journal events attribute.
+        # The scope itself is free when tenant.id is unset (None labels
+        # are dropped).
+        tenancy.configure(conf)
         counters = Counters()
         # the conf fingerprint ties the span to the exact configuration
         # that ran — the same identity checkpoint snapshots carry (GL002),
@@ -119,8 +127,9 @@ class Job:
         if tracer.enabled:
             attrs = {"conf": StreamCheckpointer.run_id_from_conf(conf),
                      "input": input_path, "output": output_path}
-        with tracer.span(f"job.{self.name or type(self).__name__}",
-                         attrs=attrs):
+        with tel.label_scope(tenant=conf.get("tenant.id")), \
+                tracer.span(f"job.{self.name or type(self).__name__}",
+                            attrs=attrs):
             self.execute(conf, input_path, output_path, counters)
         # GraftFleet (round 15): journal this job's final counter
         # snapshot under the job name — in a multi-process run EVERY
@@ -135,7 +144,11 @@ class Job:
         # double the CLI's counter-delta report and double-count in the
         # SLO evaluator's per-writer totals.
         if tracer.enabled and tracer.current() is None:
-            tracer.counters(self.name or type(self).__name__, counters)
+            # the snapshot keeps the tenant label (it is emitted after
+            # the job span closed, outside the scope above) so a
+            # per-tenant SLO filter still sees this job's totals
+            with tel.label_scope(tenant=conf.get("tenant.id")):
+                tracer.counters(self.name or type(self).__name__, counters)
         # GraftProf: flush cumulative program wall totals at the job
         # boundary — a one-shot CLI run exits without ever calling
         # Tracer.disable, and totals below the periodic flush threshold

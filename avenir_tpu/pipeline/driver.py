@@ -180,9 +180,16 @@ class Pipeline:
                         needed[prod.name] = True
                         frontier.append(prod)
             todo = [s for s in self.stages if s.name in needed]
+        from avenir_tpu import tenancy
         from avenir_tpu.telemetry import spans as tel
 
         tracer = tel.configure(self.conf)
+        # GraftPool (round 18): arm the device arbiter from tenant.*
+        # contracts (no-op without them) and run the whole pipeline AS
+        # this conf's tenant — every stage span, counter snapshot and
+        # chunk-fold dispatch slot below carries/obeys the tenant
+        tenancy.configure(self.conf)
+        tenant = self.conf.get("tenant.id")
         # ElasticGraft (round 16): resolve the elastic-restore policy once
         # at run start — shard.reshard.on.restore=true lets the restore
         # seams (WindowCheckpointer / StreamCheckpointer) redistribute a
@@ -192,9 +199,12 @@ class Pipeline:
         # root span records the policy the run restored under.
         run_attrs = {"workspace": self.workspace, "stages": len(todo),
                      "resume": bool(resume)}
+        if tenant:
+            run_attrs["tenant"] = tenant
         if self.conf.get_bool("shard.reshard.on.restore", False):
             run_attrs["reshard.on.restore"] = True
-        with tracer.span("pipeline.run", attrs=run_attrs):
+        with tel.label_scope(tenant=tenant), \
+                tracer.span("pipeline.run", attrs=run_attrs):
             # ShardGraft (round 12): resolve the shard.* topology once at
             # run start so an impossible request (more devices than
             # attached, multi-process) fails HERE, before any stage runs.

@@ -367,7 +367,20 @@ class ChunkFolder:
         return None
 
     def fold(self, ds: EncodedDataset, acc: agg.Accumulator) -> None:
-        """One chunk's device pass + 64-bit host accumulation into ``acc``."""
+        """One chunk's device pass + 64-bit host accumulation into ``acc``.
+
+        GraftPool (round 18): the fold acquires a tenant dispatch slot
+        first — batch SharedScan chunks AND stream panes both pass here,
+        so ONE arbiter hook fair-queues both against every other tenant
+        on the device pool.  Un-tenanted runs get the shared null context
+        (one attribute check); a tenant past its queue share raises the
+        typed TenantShedError to its OWN workload, never a neighbor's."""
+        from avenir_tpu import tenancy
+
+        with tenancy.pool().slot():
+            self._fold(ds, acc)
+
+    def _fold(self, ds: EncodedDataset, acc: agg.Accumulator) -> None:
         from avenir_tpu.ops import pallas_hist
         from avenir_tpu.parallel.mesh import maybe_shard_batch
 

@@ -57,6 +57,13 @@ def main(argv: List[str]) -> int:
     from avenir_tpu.telemetry.slo import SloEvaluator
 
     tel.configure(conf)
+    # GraftPool (round 18): arm the tenant arbiter from tenant.* contracts
+    # (no-op without them) — a tenant-owned serving plane (tenant.id) then
+    # draws arbitrated dispatch slots and sheds tenant-scoped 429s with
+    # Retry-After drain estimates
+    from avenir_tpu import tenancy
+
+    tenancy.configure(conf)
     slo = SloEvaluator.from_conf(conf)
     # FleetServe (round 17): any pool.* arming serves a ReplicaPool — N
     # batcher replicas with health-gated routing, breaker/heartbeat
@@ -81,7 +88,8 @@ def main(argv: List[str]) -> int:
     http = ScoreHTTPServer(
         batcher, port=port, slo=slo,
         identity=fleet_identity(
-            replica=conf.get("trace.writer.suffix"))).start()
+            replica=conf.get("trace.writer.suffix"),
+            tenant=conf.get("tenant.id"))).start()
     print(f"serving {names} on "
           f"http://{http.address[0]}:{http.address[1]} "
           f"(buckets {batcher.buckets}){pool_note}"

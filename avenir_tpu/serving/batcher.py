@@ -48,6 +48,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
+from avenir_tpu import tenancy
 from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.serving.errors import (
     ReplicaDownError,
@@ -55,6 +56,7 @@ from avenir_tpu.serving.errors import (
     RequestTimeout,
     ServingError,
     ShedError,
+    TenantShedError,
 )
 from avenir_tpu.serving.registry import ModelRegistry
 from avenir_tpu.telemetry import profile as prof_mod
@@ -123,6 +125,7 @@ class BucketedMicrobatcher:
                  counters: Optional[Counters] = None,
                  latency: Optional[Dict[str, LatencyTracker]] = None,
                  name: str = "",
+                 tenant: str = "",
                  fault: Optional[FaultPlan] = None,
                  device=None,
                  on_batch_ok: Optional[Callable[[], None]] = None,
@@ -152,6 +155,14 @@ class BucketedMicrobatcher:
         # ``heartbeat`` is the dispatcher's liveness signal, updated every
         # loop wake and read by ReplicaPool.stalled-based deadline checks
         self.name = name
+        # GraftPool (round 18): the tenant this serving plane belongs to
+        # (``tenant.id``).  The dispatcher runs under the tenant's label
+        # scope (every serve.request span/gauge it journals carries the
+        # tenant), each batch dispatch draws an arbitrated device slot
+        # under the tenant's contract, and door sheds are tenant-scoped:
+        # they name the tenant + quota and carry the queue drain estimate
+        # the HTTP frontend renders as Retry-After.
+        self.tenant = tenant
         self.fault = fault
         self.device = device
         self.on_batch_ok = on_batch_ok
@@ -159,6 +170,10 @@ class BucketedMicrobatcher:
         self.heartbeat = time.monotonic()
         self.failed = False
         self._dispatching = False
+        # per-model EWMA of batch dispatch seconds — the queue drain
+        # estimate behind a shed's Retry-After (satellite: a 429 tells
+        # the client WHEN to come back, not just "go away")
+        self._dispatch_ewma: Dict[str, float] = {}
         self._queues: Dict[str, Deque[PendingRequest]] = {
             name: deque() for name in registry.names()}
         # recompile accounting: the shared compile-key diff (telemetry,
@@ -195,6 +210,8 @@ class BucketedMicrobatcher:
         test kills its batcher through configuration alone."""
         if "fault" not in kwargs:
             kwargs["fault"] = FaultPlan.from_conf(conf)
+        if "tenant" not in kwargs:
+            kwargs["tenant"] = conf.get("tenant.id", "") or ""
         return cls(
             registry,
             bucket_sizes=conf.get_int_list("serve.bucket.sizes",
@@ -259,6 +276,7 @@ class BucketedMicrobatcher:
         entry = self.registry.get(model)            # raises UnknownModelError
         del entry
         req = PendingRequest(model, line, rid=rid)
+        shed_depth = None
         with self._cond:
             if self.failed:
                 raise self._down_error("replica is down")
@@ -267,12 +285,35 @@ class BucketedMicrobatcher:
             queue = self._queues[model]
             if len(queue) >= self.queue_depth:
                 self.counters.increment(f"Serving.{model}", "shed")
-                raise self._attribute(ShedError(
-                    f"{model!r} queue at depth {self.queue_depth}"
-                    + (f" on replica {self.name!r}" if self.name else "")
-                    + " — request shed (backpressure)"), wait_s=0.0)
-            queue.append(req)
-            self._cond.notify()
+                if self.tenant:
+                    self.counters.increment(f"Tenant.{self.tenant}", "shed")
+                shed_depth = len(queue)
+            else:
+                queue.append(req)
+                self._cond.notify()
+        if shed_depth is not None:
+            if self.tenant:
+                # tenant-scoped door shed: booked under the tenant (above,
+                # in the lock), journaled as tenant.shed and raised HERE —
+                # outside the lock, so a shed storm's journal I/O never
+                # serializes other submitters — carrying the queue drain
+                # estimate (Retry-After) + the quota that fired
+                retry_after = self.drain_estimate_s(model)
+                tel.tracer().event(
+                    "tenant.shed", tenant=self.tenant,
+                    quota="serve.queue.depth",
+                    waiting=shed_depth, inflight=0,
+                    retry_after_ms=round(retry_after * 1e3, 1))
+                raise self._attribute(TenantShedError(
+                    f"{model!r} queue at depth {self.queue_depth} for "
+                    f"tenant {self.tenant!r} — request shed "
+                    f"(backpressure); retry after ~{retry_after:.2f}s",
+                    tenant=self.tenant, quota="serve.queue.depth",
+                    retry_after_s=retry_after), wait_s=0.0)
+            raise self._attribute(ShedError(
+                f"{model!r} queue at depth {self.queue_depth}"
+                + (f" on replica {self.name!r}" if self.name else "")
+                + " — request shed (backpressure)"), wait_s=0.0)
         return req
 
     def submit(self, model: str, line: str,
@@ -310,6 +351,12 @@ class BucketedMicrobatcher:
 
     def _loop(self) -> None:
         with contextlib.ExitStack() as stack:
+            if self.tenant:
+                # the dispatcher works AS the tenant: every span, gauge
+                # and recompile event it journals carries the label, so
+                # one merged fleet view attributes this plane's serving
+                # cost to its owner
+                stack.enter_context(tel.label_scope(tenant=self.tenant))
             if self.device is not None:
                 import jax
 
@@ -404,9 +451,30 @@ class BucketedMicrobatcher:
         entry = self.registry.get(model)
         bucket = self._bucket_for(len(live))
         try:
-            t0 = time.monotonic()
-            outs = entry.score_lines([r.line for r in live], bucket)
-            dispatch_s = time.monotonic() - t0
+            # GraftPool (round 18): the batch draws an arbitrated device
+            # slot under this plane's tenant contract before it scores —
+            # serve dispatches and batch/stream chunk folds share ONE
+            # fair-queued pool.  Un-tenanted batchers pass through (the
+            # shared null context).  The slot wait is bounded by the
+            # request timeout (a tenant paced past it sheds typed rather
+            # than stranding requests) and ticks the heartbeat while
+            # queued — being PACED is not being WEDGED, and the pool's
+            # deadline watch must not reap a merely-contended replica.
+            with tenancy.pool().slot(tenant=self.tenant or None,
+                                     timeout_s=self.request_timeout_s,
+                                     on_wait=self._beat):
+                t0 = time.monotonic()
+                outs = entry.score_lines([r.line for r in live], bucket)
+                dispatch_s = time.monotonic() - t0
+        except TenantShedError as exc:
+            # the tenant's pool share refused this batch before any row
+            # scored: fail the whole batch typed — tenant-scoped, so the
+            # other tenants' planes keep dispatching
+            self.counters.increment(group, "shed", len(live))
+            self._attribute(exc)
+            for req in live:
+                req.finish(error=exc)
+            return
         except Exception as exc:
             # typed ServingErrors are REQUEST faults (bad rows); anything
             # else is an infrastructure fault the pool's breaker counts
@@ -425,6 +493,9 @@ class BucketedMicrobatcher:
             live[0].finish(error=self._attribute(
                 err, wait_s=time.monotonic() - live[0].enqueued))
             return
+        prev = self._dispatch_ewma.get(model)
+        self._dispatch_ewma[model] = (
+            dispatch_s if prev is None else 0.8 * prev + 0.2 * dispatch_s)
         if self.on_batch_ok is not None:
             self.on_batch_ok()
         self._finish_scored(entry, group, model, live, outs, bucket,
@@ -438,7 +509,14 @@ class BucketedMicrobatcher:
         bucket = self._bucket_for(1)
         for req in reqs:
             try:
-                outs = entry.score_lines([req.line], bucket)
+                with tenancy.pool().slot(tenant=self.tenant or None,
+                                         timeout_s=self.request_timeout_s,
+                                         on_wait=self._beat):
+                    outs = entry.score_lines([req.line], bucket)
+            except TenantShedError as exc:
+                self.counters.increment(group, "shed")
+                req.finish(error=self._attribute(exc))
+                continue
             except Exception as exc:
                 if self.on_batch_error is not None and \
                         not isinstance(exc, ServingError):
@@ -500,16 +578,42 @@ class BucketedMicrobatcher:
         if tracer.enabled:
             tracer.gauge(f"serve.queue.{model}", len(self._queues[model]))
 
+    def _beat(self) -> None:
+        """Heartbeat tick while queued on the tenant arbiter (a float
+        store is atomic under the GIL — same contract as the per-batch
+        refresh in ``_loop``): a paced dispatcher reads as busy, never
+        as wedged, so only true silence past the deadline is a miss."""
+        self.heartbeat = time.monotonic()
+
     # -- replica failure machinery (FleetServe, round 17) --------------------
     def _attribute(self, err: ServingError,
                    wait_s: Optional[float] = None) -> ServingError:
-        """Stamp a typed error with this replica's identity and the
-        request's queue wait, so client-visible failures triage to the
-        replica that caused them without the journal."""
+        """Stamp a typed error with this replica's identity, its tenant
+        and the request's queue wait, so client-visible failures triage
+        to the replica (and owner) that caused them without the journal."""
         err.replica = self.name or None
+        if self.tenant and getattr(err, "tenant", None) in (None, ""):
+            err.tenant = self.tenant
         if wait_s is not None:
             err.queue_wait_ms = round(wait_s * 1e3, 3)
         return err
+
+    def drain_estimate_s(self, model: str) -> float:
+        """How long this model's pending queue needs to drain: queued
+        batches × (EWMA batch dispatch + the flush deadline) — the
+        ``Retry-After`` a tenant-scoped shed carries.  Bounded by the
+        arbiter's shared clamp policy; no dispatch observed yet reads as
+        a nominal 50 ms batch."""
+        from avenir_tpu.tenancy.arbiter import (
+            RETRY_AFTER_MAX_S,
+            RETRY_AFTER_MIN_S,
+        )
+
+        depth = len(self._queues[model])
+        batches = max((depth + self.max_bucket - 1) // self.max_bucket, 1)
+        est = batches * (self._dispatch_ewma.get(model, 0.05)
+                         + self.flush_deadline_s)
+        return min(max(est, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
 
     def _down_error(self, reason: str,
                     req: Optional[PendingRequest] = None) -> ReplicaDownError:

@@ -29,6 +29,27 @@ class ShedError(ServingError):
     code = "SHED"
 
 
+class TenantShedError(ShedError):
+    """GraftPool tenant-scoped admission refusal (round 18): the TENANT's
+    contract fired — its queue share is full (``quota="queue.depth"``),
+    its in-flight quota blocked past the deadline (``quota="deadline"``),
+    or its serving door filled (``quota="serve.queue.depth"``) — so only
+    THIS tenant's work is refused; every other tenant keeps its share of
+    the pool.  Carries the attribution the client needs to back off
+    intelligently: ``tenant``, ``quota`` (which contract limit fired) and
+    ``retry_after_s`` (the shedding tenant's queue drain estimate — the
+    HTTP frontend renders it as a ``Retry-After`` header)."""
+
+    code = "TENANT_SHED"
+
+    def __init__(self, message: str, tenant: str = "", quota: str = "",
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.tenant = tenant
+        self.quota = quota
+        self.retry_after_s = retry_after_s
+
+
 class RequestTimeout(ServingError):
     """The request aged past ``serve.request.timeout.ms`` before a batch
     picked it up (sustained overload past what backpressure absorbs)."""

@@ -45,13 +45,22 @@ _HTTP_STATUS = {
 
 
 def _status_for(err: ServingError) -> int:
-    return _HTTP_STATUS.get(type(err), 500)
+    # MRO walk, not an exact-type lookup: subclassed typed errors (e.g.
+    # the tenant-scoped TenantShedError) keep their base's transport
+    # status — a shed is a 429 whoever shed it
+    for klass in type(err).__mro__:
+        if klass in _HTTP_STATUS:
+            return _HTTP_STATUS[klass]
+    return 500
 
 
 def _error_body(err: ServingError) -> dict:
     """Typed error → JSON body, carrying the FleetServe attribution the
     batcher stamps (which replica shed/timed out this request and how
-    long it waited) so a shed storm triages from client logs alone."""
+    long it waited) so a shed storm triages from client logs alone.
+    GraftPool (round 18): a tenant-scoped shed additionally names the
+    tenant, the contract quota that fired, and the queue drain estimate
+    — a 429 is no longer anonymous to the client."""
     body = {"error": err.code, "message": str(err)}
     replica = getattr(err, "replica", None)
     if replica:
@@ -59,7 +68,26 @@ def _error_body(err: ServingError) -> dict:
     wait_ms = getattr(err, "queue_wait_ms", None)
     if wait_ms is not None:
         body["queue_wait_ms"] = wait_ms
+    tenant = getattr(err, "tenant", None)
+    if tenant:
+        body["tenant"] = tenant
+    quota = getattr(err, "quota", None)
+    if quota:
+        body["quota"] = quota
+    retry_after = getattr(err, "retry_after_s", None)
+    if retry_after:
+        body["retry_after_ms"] = round(float(retry_after) * 1e3, 1)
     return body
+
+
+def _retry_after_header(err: ServingError) -> dict:
+    """``Retry-After`` (integer seconds, HTTP semantics — rounded UP so
+    an honest client never re-arrives early) for errors carrying a queue
+    drain estimate; ``{}`` otherwise."""
+    retry_after = getattr(err, "retry_after_s", None)
+    if not retry_after:
+        return {}
+    return {"Retry-After": str(max(int(-(-float(retry_after) // 1)), 1))}
 
 
 class ScoreHTTPServer:
@@ -87,7 +115,8 @@ class ScoreHTTPServer:
         # gauges per scrape.  Default identity reuses the tracer's writer
         # suffix so scrape labels and journal shard names agree.
         self.identity = identity if identity is not None else fleet_identity(
-            replica=_tel.tracer().writer_suffix or None)
+            replica=_tel.tracer().writer_suffix or None,
+            tenant=getattr(batcher, "tenant", "") or None)
         self.slo = slo
         outer = self
 
@@ -95,16 +124,20 @@ class ScoreHTTPServer:
             def log_message(self, *args):      # no per-request stderr spam
                 pass
 
-            def _send(self, status: int, payload: dict) -> None:
+            def _send(self, status: int, payload: dict,
+                      headers: Optional[dict] = None) -> None:
                 self._send_text(status, json.dumps(payload),
-                                "application/json")
+                                "application/json", headers=headers)
 
             def _send_text(self, status: int, text: str,
-                           content_type: str) -> None:
+                           content_type: str,
+                           headers: Optional[dict] = None) -> None:
                 body = text.encode()
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -192,7 +225,8 @@ class ScoreHTTPServer:
                 try:
                     results = outer.score_rows(model, rows)
                 except ServingError as err:
-                    self._send(_status_for(err), _error_body(err))
+                    self._send(_status_for(err), _error_body(err),
+                               headers=_retry_after_header(err))
                     return
                 self._send(200, {"model": model, "results": results})
 

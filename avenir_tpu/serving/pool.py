@@ -163,10 +163,15 @@ class ReplicaPool:
                  queue_frac: float = 0.5,
                  autoscale_interval_s: float = 5.0,
                  slo=None,
+                 tenant: str = "",
                  start_monitor: bool = True):
         if replicas < 1:
             raise ConfigError(f"pool.replicas must be >= 1, got {replicas}")
         self._factory = factory
+        # GraftPool (round 18): the tenant this pool serves (tenant.id) —
+        # each replica's batcher reads the same conf key itself; the pool
+        # carries it so door sheds ("no ready replica") attribute too
+        self.tenant = tenant
         self.counters = counters if counters is not None else Counters()
         self.latency: Dict[str, LatencyTracker] = (
             latency if latency is not None else {})
@@ -264,6 +269,7 @@ class ReplicaPool:
             autoscale_interval_s=conf.get_float(
                 "pool.autoscale.interval.sec", 5.0),
             slo=SloEvaluator.from_conf(conf),
+            tenant=conf.get("tenant.id", "") or "",
         )
         kwargs.update(overrides)
         replicas = kwargs.pop("replicas")
@@ -313,9 +319,12 @@ class ReplicaPool:
             if replica is None:
                 self.counters.increment(f"Serving.{req.model}", "shed")
                 self.counters.increment("Pool", "no.ready")
-                raise ShedError(
+                err = ShedError(
                     f"no ready replica for {req.model!r} "
                     f"(request {req.rid}) — shed at the pool door")
+                if self.tenant:
+                    err.tenant = self.tenant
+                raise err
             try:
                 req.inner = replica.batcher.submit_nowait(
                     req.model, req.line, rid=req.rid)

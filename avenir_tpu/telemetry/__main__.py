@@ -30,6 +30,9 @@ Subcommands (the bare ``<journal>`` form keeps rendering the span tree):
   ``slo.<name>.*`` rules (``--conf`` properties file and/or inline
   ``--rule NAME=METRIC<=TARGET``) over the journal; exits 0 clean / 1
   violated — the CI verdict the serving soak harness closes on.
+  ``--label KEY=VALUE`` (round 18) restricts evaluation to events
+  carrying that label — ``--label tenant=<id>`` computes one tenant's
+  verdict from a merged multi-tenant fleet journal.
 - ``profile <journal>`` — the GraftProf roofline table: one row per
   compiled program (``program.compiled`` + cumulative ``program.profile``
   events) with dispatch counts, wall time, achieved FLOP/s and an MFU
@@ -208,6 +211,19 @@ def durability_lines(events: List[dict]) -> List[str]:
                        f"(burn={e.get('burn', '?')} "
                        f"queue_frac={e.get('queue_frac', '?')} "
                        f"reason={e.get('reason', '?')})")
+        elif ev == "tenant.admitted":
+            out.append(f"  {ev:<20} tenant={e.get('tenant', '?')} "
+                       f"share={e.get('share', '?')} "
+                       f"priority={e.get('priority', '?')}")
+        elif ev == "tenant.throttled":
+            out.append(f"  {ev:<20} tenant={e.get('tenant', '?')} "
+                       f"reason={e.get('reason', '?')} "
+                       f"waiting={e.get('waiting', '?')}")
+        elif ev == "tenant.shed":
+            out.append(f"  {ev:<20} tenant={e.get('tenant', '?')} "
+                       f"quota={e.get('quota', '?')} "
+                       f"waiting={e.get('waiting', '?')} "
+                       f"retry_after_ms={e.get('retry_after_ms', '?')}")
     return out
 
 
@@ -444,9 +460,22 @@ def slo_cli(rest: List[str]) -> int:
     ap.add_argument("--rule", action="append", default=[],
                     metavar="NAME=METRIC<=TARGET",
                     help="inline rule (repeatable; >= for lower bounds)")
+    ap.add_argument("--label", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="evaluate only events carrying this label "
+                         "(repeatable; e.g. tenant=analytics — the "
+                         "per-tenant verdict over a merged fleet journal)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print the full summary as JSON")
     args = ap.parse_args(rest)
+    labels = {}
+    for spec in args.label:
+        key, eq, value = spec.partition("=")
+        if not key or not eq:
+            print(f"--label expects KEY=VALUE, got {spec!r}",
+                  file=sys.stderr)
+            return 2
+        labels[key] = value
     rules = []
     if args.conf:
         from avenir_tpu.core.config import ConfigError, JobConfig
@@ -472,11 +501,15 @@ def slo_cli(rest: List[str]) -> int:
     except OSError as exc:
         print(f"cannot read journal: {exc}", file=sys.stderr)
         return 2
+    if labels:
+        events = slo_mod.filter_events_by_labels(events, labels)
     summary = slo_mod.evaluate_events(events, rules)
     if args.as_json:
         print(json.dumps(summary))
     else:
-        print(f"{args.journal}: {summary['verdict'].upper()}")
+        scope = ("".join(f" [{k}={v}]" for k, v in sorted(labels.items()))
+                 if labels else "")
+        print(f"{args.journal}{scope}: {summary['verdict'].upper()}")
         for row in summary["rules"]:
             burn = ("-" if row["burn_rate"] is None
                     else f"{row['burn_rate']:.3f}")

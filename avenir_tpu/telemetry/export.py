@@ -41,12 +41,15 @@ def _label_text(labels: Optional[Mapping[str, str]]) -> str:
     return "".join(f'{k}="{_escape(v)}",' for k, v in sorted(labels.items()))
 
 
-def fleet_identity(replica: Optional[str] = None) -> Dict[str, str]:
+def fleet_identity(replica: Optional[str] = None,
+                   tenant: Optional[str] = None) -> Dict[str, str]:
     """This writer's scrape identity: the jax process index (0 outside a
     distributed run — guarded, never initializes a backend by surprise)
     plus the replica/worker suffix when the deployment sets one
     (``trace.writer.suffix`` — the same knob that names the journal
-    shard, so scrape labels and shard names agree)."""
+    shard, so scrape labels and shard names agree) and — GraftPool,
+    round 18 — the tenant a dedicated serving plane belongs to
+    (``tenant.id``), so per-tenant scrapes never collide on series."""
     proc = 0
     try:
         import jax
@@ -57,6 +60,8 @@ def fleet_identity(replica: Optional[str] = None) -> Dict[str, str]:
     out = {"process": str(proc)}
     if replica:
         out["replica"] = str(replica)
+    if tenant:
+        out["tenant"] = str(tenant)
     return out
 
 

@@ -172,6 +172,31 @@ def parse_rule_spec(spec: str) -> SloRule:
                    op="max" if m.group(2) == "<=" else "min")
 
 
+def filter_events_by_labels(events: List[dict],
+                            labels: Mapping[str, str]) -> List[dict]:
+    """Events carrying EVERY given label — matched against the record's
+    top-level fields (the GraftPool ``label_scope`` stamp / the
+    per-process ``tenant.id`` journal stamp) or its span ``attrs``.
+
+    The ``telemetry slo --label tenant=<id>`` seam (round 18): one
+    merged fleet journal holds every tenant's events, and a per-tenant
+    verdict evaluates the same rules over just that tenant's slice —
+    unlabeled events (another tenant's, or infrastructure outside any
+    scope) are excluded, so tenant A's shed storm can never fail tenant
+    B's gate."""
+    def match(event: dict) -> bool:
+        attrs = event.get("attrs") or {}
+        for key, value in labels.items():
+            if str(event.get(key)) == value:
+                continue
+            if str(attrs.get(key)) == value:
+                continue
+            return False
+        return True
+
+    return [e for e in events if match(e)]
+
+
 # ---------------------------------------------------------------------------
 # metric extraction — post-hoc (journal events)
 # ---------------------------------------------------------------------------

@@ -57,6 +57,42 @@ from avenir_tpu.telemetry.journal import Journal
 _CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "avenir_tpu_current_span", default=None)
 
+# GraftPool (round 18): ambient journal labels.  A tenant's workload runs
+# under ``label_scope(tenant=...)`` and EVERY event emitted from inside —
+# span opens/closes, counter snapshots, gauges, recompiles, sheds — is
+# stamped with the label at emit time, so one merged fleet journal
+# attributes every span and every shed to its tenant without per-seam
+# plumbing.  Independent of ``trace.on``: the tenancy arbiter reads the
+# ambient ``tenant`` label even when nothing journals.
+_LABELS: "contextvars.ContextVar[Optional[Dict[str, Any]]]" = \
+    contextvars.ContextVar("avenir_tpu_trace_labels", default=None)
+
+
+def current_labels() -> Dict[str, Any]:
+    """A copy of the ambient label set ({} outside any scope)."""
+    return dict(_LABELS.get() or {})
+
+
+def current_label(key: str) -> Optional[Any]:
+    """One ambient label (no dict copy — the arbiter's hot-path read)."""
+    labels = _LABELS.get()
+    return labels.get(key) if labels else None
+
+
+@contextlib.contextmanager
+def label_scope(**labels) -> Iterator[None]:
+    """Attach journal labels to everything emitted inside the scope.
+    Scopes nest (inner wins on a shared key); ``None`` values are
+    dropped, so ``label_scope(tenant=conf.get("tenant.id"))`` is a
+    no-op scope when the conf names no tenant."""
+    live = {k: v for k, v in labels.items() if v is not None}
+    merged = {**(_LABELS.get() or {}), **live}
+    token = _LABELS.set(merged)
+    try:
+        yield
+    finally:
+        _LABELS.reset(token)
+
 
 class Span:
     """One unit of work: identity (trace/span/parent ids), a name, attrs,
@@ -164,7 +200,7 @@ class Tracer:
     # -- lifecycle -----------------------------------------------------------
     def enable(self, journal_dir: Optional[str] = None,
                max_bytes: int = 64 << 20, run_id: Optional[str] = None,
-               suffix: str = "") -> "Tracer":
+               suffix: str = "", tenant: str = "") -> "Tracer":
         """Turn tracing on; with ``journal_dir``, open the run journal
         there (single-writer, rotation-bounded).
 
@@ -195,6 +231,11 @@ class Tracer:
             self.stamp = {"proc": proc, "host": socket.gethostname()}
             if suffix:
                 self.stamp["replica"] = suffix
+            if tenant:
+                # GraftPool (round 18): a process dedicated to one tenant
+                # (tenant.id in its conf) stamps every record — the
+                # multi-process twin of the in-process label_scope
+                self.stamp["tenant"] = tenant
             fleet = bool(run_id) or bool(suffix) or proc != 0
             if fleet:
                 writer = f"proc-{proc}" + (f"-{suffix}" if suffix else "")
@@ -315,6 +356,12 @@ class Tracer:
             if ts is not None:
                 # retroactive events carry their own timestamp
                 fields["at"] = round(ts, 6)
+            labels = _LABELS.get()
+            if labels:
+                # ambient labels (GraftPool tenant attribution) ride every
+                # record; an explicit field of the same name wins
+                for key, value in labels.items():
+                    fields.setdefault(key, value)
             self.journal.emit(ev, **fields)
 
     def event(self, ev: str, **fields) -> None:
@@ -419,13 +466,19 @@ def configure(conf) -> Tracer:
         nprocs = jax.process_count()
     except Exception:                              # pragma: no cover
         pass
-    suffix = conf.get("trace.writer.suffix", "")
+    # GraftPool (round 18): a tenant-dedicated process (tenant.id) shards
+    # its journal like a replica — the tenant names the writer suffix when
+    # no explicit one is set — and stamps every record with the tenant, so
+    # a merged fleet view attributes each shard's events without parsing
+    # filenames.  In-process multi-tenant runs use label_scope instead.
+    tenant = conf.get("tenant.id", "") or ""
+    suffix = conf.get("trace.writer.suffix", "") or tenant
     fleet = nprocs > 1 or bool(suffix) or bool(conf.get("trace.run.id"))
     max_mb = conf.get_float("telemetry.journal.max.mb", 64.0)
     t.enable(conf.get("trace.journal.dir") or ".",
              max_bytes=int(max_mb * (1 << 20)),
              run_id=fleet_run_id(conf) if fleet else None,
-             suffix=suffix)
+             suffix=suffix, tenant=tenant)
     return t
 
 

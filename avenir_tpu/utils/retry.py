@@ -221,7 +221,13 @@ class FaultPlan:
     - ``fault.serve.heartbeat.crash.after`` — wedge the serving
       dispatcher on its N-th loop wake: the thread exits WITHOUT
       finishing pending work, so the replica's heartbeat goes stale and
-      the pool's deadline detection is what has to catch it.
+      the pool's deadline detection is what has to catch it;
+    - ``fault.tenant.flood.after`` — the GraftPool noisy-tenant drill
+      (round 18): fire on a tenant workload's N-th pacing boundary.  The
+      workload driver (``benchmarks/tenancy_soak.py``) treats the raise
+      as "go noisy": it stops pacing and floods the arbiter, which must
+      throttle then shed THAT tenant while the others' SLOs stay green —
+      misbehavior armed from configuration alone, like every other site.
 
     Each firing journals a golden-schema'd ``fault.injected`` event
     (site, 1-based hit number) so the run's trace explains the drill.
@@ -231,7 +237,7 @@ class FaultPlan:
     None when no ``fault.*`` key is armed — the zero-cost default)."""
 
     SITES = ("fold", "checkpoint.save", "checkpoint.restore",
-             "serve.dispatch", "serve.heartbeat")
+             "serve.dispatch", "serve.heartbeat", "tenant.flood")
 
     def __init__(self, schedule: Dict[str, int]):
         unknown = set(schedule) - set(self.SITES)
@@ -257,6 +263,8 @@ class FaultPlan:
                 conf.get_int("fault.serve.dispatch.crash.after", 0) or 0,
             "serve.heartbeat":
                 conf.get_int("fault.serve.heartbeat.crash.after", 0) or 0,
+            "tenant.flood":
+                conf.get_int("fault.tenant.flood.after", 0) or 0,
         }
         plan = cls(sched)
         return plan if plan.schedule else None

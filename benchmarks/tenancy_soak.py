@@ -1,0 +1,454 @@
+#!/usr/bin/env python
+"""GraftPool multi-tenant soak: N concurrent workloads from different
+owners on ONE device pool, isolation as a measured, journal-proved
+artifact.
+
+Four tenants run concurrently under ``tenant.*`` contracts against one
+capacity-1 device arbiter (``avenir_tpu/tenancy``):
+
+- **batch** — repeated NB+MI pipelines through the driver (fused
+  SharedScan; every chunk fold draws an arbitrated dispatch slot);
+- **stream** — windowed analytics with drift detection and the
+  drift→retrain→hot-swap loop (panes fold through the SAME seam);
+- **serve** — a tenant-owned :class:`BucketedMicrobatcher` under closed-
+  loop request bursts (each batch dispatch draws a slot; priority 1 —
+  latency outranks backfill);
+- **noisy** — a ``fault.tenant.flood.after``-armed tenant that starts
+  polite and goes rogue mid-soak, flooding the arbiter far past its
+  1-slot quota and 2-deep queue share.
+
+Acceptance, all machine-checked over the merged fleet journal (every
+event tenant-labeled by ``label_scope``/the batcher dispatcher/the
+driver):
+
+- the noisy tenant is THROTTLED then SHED — journal-proved
+  ``tenant.throttled`` + ``tenant.shed`` events with ``tenant=noisy``
+  stamps, and its own SLO gate (``counter:Tenant.noisy:shed <= 0``)
+  exits 1 — the gate catches the offender;
+- every survivor's ``telemetry slo --conf <rules> --label tenant=<id>``
+  verdict exits 0 (per-tenant rules via the ``tenant.<id>.slo.*``
+  grammar): serve p99 + shed.rate, batch/stream zero tenant sheds,
+  stream zero pane recompiles;
+- ``steady_state_recompiles_total == 0`` across the warmed planes
+  (serve batcher, stream panes, the stream tenant's swap target) —
+  compiled-program sharing survives multi-tenancy;
+- the drift→retrain→swap loop completed under contention (model v2).
+
+One JSON artifact line on stdout; a fresh matmul canary rides in it per
+the PR-2 convention (a loaded rig indicts itself, not the arbiter).
+"""
+
+import glob
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["r", "g", "b"], "feature": True},
+        {"name": "size", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["s", "m", "l"], "feature": True},
+        {"name": "score", "ordinal": 3, "dataType": "double",
+         "feature": True},
+        {"name": "status", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["pos", "neg"]},
+    ]
+}
+
+
+def gen_lines(n, seed, flip=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        color = ["r", "g", "b"][int(rng.integers(0, 3))]
+        size = ["s", "m", "l"][int(rng.integers(0, 3))]
+        score = (8 + int(rng.integers(0, 17))) / 16.0 + \
+            (1.0 if color == "r" else 0.0)
+        p_pos = 0.9 if color == "r" else 0.15
+        if flip:
+            p_pos = 1.0 - p_pos
+        status = "pos" if rng.random() < p_pos else "neg"
+        out.append(f"id{i},{color},{size},{score!r},{status}")
+    return out
+
+
+def run_soak(batch_rounds=3, steady_panes=10, drifted_panes=8,
+             serve_bursts=24, burst_size=8, pane_rows=128,
+             noisy_polite_iters=6, noisy_flood_workers=5,
+             noisy_flood_iters=8, p99_target_ms=60000.0, canary=True):
+    """The soak body; the tier-1 smoke runs it miniaturized through the
+    IDENTICAL code path (``canary=False`` skips the rig canary — the
+    smoke pins correctness, not rig speed).  Returns the artifact dict;
+    raises RuntimeError on any gate failure."""
+    from avenir_tpu import tenancy
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.pipeline import scan
+    from avenir_tpu.pipeline.driver import Pipeline, Stage
+    from avenir_tpu.serving import BucketedMicrobatcher, ModelRegistry
+    from avenir_tpu.serving.errors import ServingError, TenantShedError
+    from avenir_tpu.stream import (
+        ClassDistributionConsumer,
+        DriftDetector,
+        DriftRetrainController,
+        WindowedScan,
+    )
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry.__main__ import main as telemetry_cli
+    from avenir_tpu.telemetry.journal import read_events
+    from avenir_tpu.utils.metrics import Counters
+    from avenir_tpu.utils.retry import FaultPlan, InjectedFault
+
+    tenancy.reset()
+    root = tempfile.mkdtemp(prefix="tenancy_soak_")
+    j = lambda *p: os.path.join(root, *p)
+    with open(j("schema.json"), "w") as fh:
+        fh.write(json.dumps(SCHEMA))
+    with open(j("train.csv"), "w") as fh:
+        fh.write("\n".join(gen_lines(2048, seed=7)) + "\n")
+    test_lines = gen_lines(256, seed=11)
+
+    base = {
+        "feature.schema.file.path": j("schema.json"),
+        # the observability plane the acceptance reads: ONE run id, every
+        # event tenant-labeled by the scopes below
+        "trace.on": "true",
+        "trace.journal.dir": root,
+        "trace.run.id": "tenancysoak",
+        # the contracts: serve outranks backfill; noisy is boxed to one
+        # concurrent slot, a 2-deep queue share and a short deadline
+        "tenant.pool.concurrency": "1",
+        "tenant.batch.share": "2",
+        "tenant.stream.share": "2",
+        "tenant.serve.share": "4",
+        "tenant.serve.priority": "1",
+        "tenant.noisy.share": "1",
+        "tenant.noisy.max.inflight": "1",
+        "tenant.noisy.queue.depth": "2",
+        "tenant.noisy.queue.timeout.ms": "200",
+        # per-tenant SLO rules (the tenant.<id>.slo.* grammar)
+        "tenant.serve.slo.p99.metric": "p99.latency.ms",
+        "tenant.serve.slo.p99.target": str(p99_target_ms),
+        "tenant.serve.slo.shed.metric": "shed.rate",
+        "tenant.serve.slo.shed.target": "0",
+        "tenant.batch.slo.shed.metric": "counter:Tenant.batch:shed",
+        "tenant.batch.slo.shed.target": "0",
+        "tenant.stream.slo.shed.metric": "counter:Tenant.stream:shed",
+        "tenant.stream.slo.shed.target": "0",
+        "tenant.stream.slo.recompiles.metric": "counter:Stream:recompiles",
+        "tenant.stream.slo.recompiles.target": "0",
+        "tenant.noisy.slo.shed.metric": "counter:Tenant.noisy:shed",
+        "tenant.noisy.slo.shed.target": "0",
+        # the chaos: the noisy tenant goes rogue on its N-th pacing
+        # boundary, armed from configuration alone
+        "fault.tenant.flood.after": str(noisy_polite_iters),
+    }
+    base_conf = JobConfig(dict(base))
+    tel.configure(base_conf)
+    gp = tenancy.configure(base_conf)
+    canary_ms = None
+    if canary:
+        from avenir_tpu.utils.rig_canary import matmul_canary_ms
+
+        canary_ms = matmul_canary_ms()
+
+    # serve + stream model artifacts (setup, outside the soak clock)
+    fit_conf = {"feature.schema.file.path": j("schema.json")}
+    get_job("BayesianDistribution").run(JobConfig(dict(fit_conf)),
+                                        j("train.csv"), j("nb_serve"))
+    get_job("BayesianDistribution").run(JobConfig(dict(fit_conf)),
+                                        j("train.csv"), j("nb_stream"))
+    serve_props = {"serve.models": "naiveBayes",
+                   "serve.bucket.sizes": "1,2,4,8",
+                   "serve.flush.deadline.ms": "4",
+                   "serve.request.timeout.ms": "30000"}
+    conf_serve = JobConfig({**base, **serve_props, "tenant.id": "serve",
+                            "bayesian.model.file.path": j("nb_serve")})
+    conf_stream = JobConfig({**base, **serve_props, "tenant.id": "stream",
+                             "bayesian.model.file.path": j("nb_stream"),
+                             "stream.retrain.dir": j("retrain")})
+    serve_b = BucketedMicrobatcher.from_conf(
+        ModelRegistry.from_conf(conf_serve), conf_serve)
+    stream_b = BucketedMicrobatcher.from_conf(
+        ModelRegistry.from_conf(conf_stream), conf_stream)
+
+    errors = []
+    results = {}
+
+    def batch_worker():
+        # the driver runs each pipeline AS tenant "batch" (tenant.id) —
+        # fused NB+MI SharedScan, every chunk fold arbitrated
+        total = Counters()
+        for r in range(batch_rounds):
+            conf_b = JobConfig({**base, "tenant.id": "batch"})
+            p = Pipeline(j(f"batch-{r}"), conf_b)
+            p.bind("data", j("train.csv"))
+            p.add(Stage("nb", "BayesianDistribution", "data", "nb_out"))
+            p.add(Stage("mi", "MutualInformation", "data", "mi_out"))
+            p.run()
+            total.merge_add(p.rollup())
+        results["batch_counters"] = total
+
+    def stream_worker():
+        with tenancy.tenant_scope("stream"):
+            enc = DatasetEncoder(FeatureSchema.from_file(j("schema.json")))
+            detector = DriftDetector(threshold=0.01, min_windows=2,
+                                     source="class")
+            controller = DriftRetrainController(conf_stream, stream_b,
+                                                detector)
+            ws = WindowedScan(
+                enc, [ClassDistributionConsumer(name="cd"),
+                      scan.NaiveBayesConsumer(name="nb")],
+                pane_rows=pane_rows, window_panes=2, slide_panes=1,
+                retain_rows=True)
+            ws.warm()
+            steady = gen_lines(steady_panes * pane_rows, seed=13)
+            for start in range(0, len(steady), pane_rows):
+                for window in ws.feed(steady[start:start + pane_rows]):
+                    controller.on_window(window)
+            drifted = gen_lines(drifted_panes * pane_rows, seed=17,
+                                flip=True)
+            for start in range(0, len(drifted), pane_rows):
+                for window in ws.feed(drifted[start:start + pane_rows]):
+                    controller.on_window(window)
+            for window in ws.flush():
+                controller.on_window(window)
+            results["stream_ws"] = ws
+            results["stream_swaps"] = controller.swaps
+
+    def serve_worker():
+        with tenancy.tenant_scope("serve"):
+            ok = shed = 0
+            for b in range(serve_bursts):
+                pending = []
+                for i in range(burst_size):
+                    line = test_lines[(b * burst_size + i) % len(test_lines)]
+                    try:
+                        pending.append(serve_b.submit_nowait("naiveBayes",
+                                                             line))
+                    except ServingError:
+                        shed += 1
+                for req in pending:
+                    try:
+                        req.wait(60.0)
+                        ok += 1
+                    except ServingError:
+                        shed += 1
+                time.sleep(0.005)
+            results["serve_ok"] = ok
+            results["serve_shed"] = shed
+
+    def noisy_worker():
+        from avenir_tpu.core.csv_io import read_csv_string
+
+        fault = FaultPlan.from_conf(base_conf)
+        enc = DatasetEncoder(FeatureSchema.from_file(j("schema.json")))
+        small = enc.transform(
+            read_csv_string("\n".join(gen_lines(64, seed=23))),
+            with_labels=True)
+
+        def one_fold():
+            eng = scan.SharedScan()
+            eng.register(scan.NaiveBayesConsumer(name="nb"))
+            eng.run(small)
+
+        with tenancy.tenant_scope("noisy"):
+            flood = False
+            sheds = [0]
+            for _ in range(noisy_polite_iters + 1):
+                try:
+                    fault.hit("tenant.flood")
+                except InjectedFault:
+                    flood = True        # the drill: go rogue mid-soak
+                    break
+                try:
+                    one_fold()
+                except TenantShedError:
+                    # even polite work can hit the tenant's own 200 ms
+                    # deadline under startup contention — its contract,
+                    # its shed; never a neighbor's problem
+                    sheds[0] += 1
+                time.sleep(0.02)
+            if flood:
+                lock = threading.Lock()
+
+                def flood_loop():
+                    with tenancy.tenant_scope("noisy"):
+                        for _ in range(noisy_flood_iters):
+                            try:
+                                one_fold()
+                            except TenantShedError:
+                                with lock:
+                                    sheds[0] += 1
+                rogues = [threading.Thread(target=flood_loop)
+                          for _ in range(noisy_flood_workers)]
+                for t in rogues:
+                    t.start()
+                for t in rogues:
+                    t.join(120.0)
+            results["noisy_flooded"] = flood
+            results["noisy_client_sheds"] = sheds[0]
+
+    workers = [threading.Thread(target=fn, name=name) for name, fn in (
+        ("soak-batch", batch_worker), ("soak-stream", stream_worker),
+        ("soak-serve", serve_worker), ("soak-noisy", noisy_worker))]
+
+    def guarded(thread):
+        run = thread.run
+
+        def wrapper():
+            try:
+                run()
+            except BaseException as exc:          # noqa: BLE001 — surfaced
+                errors.append(f"{thread.name}: {type(exc).__name__}: {exc}")
+        thread.run = wrapper
+        return thread
+
+    t0 = time.perf_counter()
+    for t in workers:
+        guarded(t).start()
+    for t in workers:
+        t.join(600.0)
+    soak_s = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(f"tenant workload(s) died: {errors}")
+
+    # -- the books: one tenant-labeled merged snapshot per tenant -------------
+    tracer = tel.tracer()
+    arb_groups = gp.counters.as_dict()
+
+    def tenant_snapshot(tenant, *sources):
+        merged = Counters()
+        for src in sources:
+            merged.merge_add(src)
+        for name, value in arb_groups.get(f"Tenant.{tenant}", {}).items():
+            merged.increment(f"Tenant.{tenant}", name, value)
+        with tenancy.tenant_scope(tenant):
+            tracer.counters(f"tenant.{tenant}", merged)
+        return merged
+
+    ws = results["stream_ws"]
+    tenant_snapshot("batch", results["batch_counters"])
+    tenant_snapshot("stream", ws.counters, stream_b.counters)
+    tenant_snapshot("serve", serve_b.counters)
+    noisy_books = tenant_snapshot("noisy")
+    recompiles = int(ws.counters.get("Stream", "recompiles") or 0)
+    for counters in (serve_b.counters, stream_b.counters):
+        recompiles += sum(vals.get("recompiles", 0) for group, vals in
+                          counters.as_dict().items()
+                          if group.startswith("Serving."))
+    serve_b.close()
+    stream_b.close()
+    tracer.disable()
+    tenancy.reset()
+
+    # -- the merged fleet journal is the acceptance artifact ------------------
+    rc_merge = telemetry_cli(["merge", root])
+    fleet = sorted(glob.glob(j("fleet-*.jsonl")))
+    if rc_merge != 0 or not fleet:
+        raise RuntimeError(f"journal merge failed (rc={rc_merge})")
+    events = read_events(fleet[-1])
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e["ev"], []).append(e)
+    noisy_sheds = [e for e in by_ev.get("tenant.shed", [])
+                   if e.get("tenant") == "noisy"]
+    noisy_throttles = [e for e in by_ev.get("tenant.throttled", [])
+                       if e.get("tenant") == "noisy"]
+    if not results.get("noisy_flooded"):
+        raise RuntimeError("fault.tenant.flood.after never fired — the "
+                           "noisy-tenant drill did not run")
+    if not noisy_throttles or not noisy_sheds:
+        raise RuntimeError(
+            f"noisy tenant was not throttled-then-shed "
+            f"(throttled={len(noisy_throttles)}, shed={len(noisy_sheds)})")
+    foreign_sheds = [e for e in by_ev.get("tenant.shed", [])
+                     if e.get("tenant") != "noisy"]
+    if foreign_sheds:
+        raise RuntimeError(
+            f"shedding leaked across tenant boundaries: {foreign_sheds}")
+    admitted = {e.get("tenant") for e in by_ev.get("tenant.admitted", [])}
+    if "noisy" not in admitted:
+        raise RuntimeError(f"tenant.admitted missing: {admitted}")
+    unattributed = [e for e in by_ev.get("span.close", [])
+                    if e.get("name") == "serve.request"
+                    and e.get("tenant") not in ("serve", "stream")]
+    if unattributed:
+        raise RuntimeError(
+            f"serve.request spans without tenant stamps: "
+            f"{unattributed[:3]}")
+    if results.get("stream_swaps", 0) < 1:
+        raise RuntimeError("drift→retrain→swap never completed under "
+                           "multi-tenant contention")
+
+    # -- per-tenant SLO verdicts over the ONE merged journal ------------------
+    slo_exits = {}
+    for tenant in ("batch", "stream", "serve", "noisy"):
+        prefix = f"tenant.{tenant}.slo."
+        rules = [f"slo.{k[len(prefix):]}={v}" for k, v in base.items()
+                 if k.startswith(prefix)]
+        rules_path = j(f"slo-{tenant}.properties")
+        with open(rules_path, "w") as fh:
+            fh.write("\n".join(rules) + "\n")
+        slo_exits[tenant] = telemetry_cli(
+            ["slo", fleet[-1], "--conf", rules_path,
+             "--label", f"tenant={tenant}"])
+    survivors_green = all(slo_exits[t] == 0
+                          for t in ("batch", "stream", "serve"))
+
+    artifact = {
+        "benchmark": "tenancy_soak",
+        "canary_ms": round(canary_ms, 3) if canary_ms is not None else None,
+        "tenants": 4,
+        "soak_s": round(soak_s, 2),
+        "batch_rounds": batch_rounds,
+        "batch_rows": int(results["batch_counters"].get(
+            "Records", "Processed") or 0),
+        "stream_windows": ws.windows_emitted,
+        "stream_swaps": results["stream_swaps"],
+        "serve_ok": results["serve_ok"],
+        "serve_shed": results["serve_shed"],
+        "noisy_sheds_booked": int(noisy_books.get(
+            "Tenant.noisy", "shed") or 0),
+        "noisy_throttled_events": len(noisy_throttles),
+        "noisy_shed_events": len(noisy_sheds),
+        "tenant_grants": {t: row["grants"]
+                          for t, row in gp.stats().items()} if gp.enabled
+        else {},
+        "steady_state_recompiles_total": recompiles,
+        "slo_exits": slo_exits,
+        "survivors_green": survivors_green,
+    }
+    if recompiles != 0:
+        raise RuntimeError(
+            f"steady_state_recompiles_total={recompiles}: a warmed plane "
+            f"recompiled under multi-tenant contention")
+    if not survivors_green:
+        raise RuntimeError(
+            f"a surviving tenant's SLO gate failed: {slo_exits} — "
+            f"isolation broke")
+    if slo_exits["noisy"] != 1:
+        raise RuntimeError(
+            f"the noisy tenant's own gate exited {slo_exits['noisy']}, "
+            f"expected 1 — the per-tenant verdict must catch the offender")
+    if results["serve_shed"]:
+        raise RuntimeError(
+            f"the serving tenant shed {results['serve_shed']} request(s) "
+            f"while the noisy tenant flooded — isolation broke")
+    return artifact
+
+
+def main():
+    print(json.dumps(run_soak()))
+
+
+if __name__ == "__main__":
+    main()

@@ -387,6 +387,11 @@ def test_whole_tree_zero_nonbaselined_findings():
     # burst timing (GL005) would hide (serving/pool.py itself sits
     # inside the avenir_tpu tree; benchmarks/serving_soak.py inside the
     # benchmarks tree the gate already walks)
+    # tests/test_tenancy.py likewise (round 18) — the GraftPool tests
+    # drive the tenant arbiter + the multi-tenant soak smoke, where an
+    # undocumented tenant.*/fault.tenant.* key (GL004) or a sync-in-loop
+    # around the DRR harness (GL005) would hide (avenir_tpu/tenancy/ and
+    # benchmarks/tenancy_soak.py sit inside trees the gate already walks)
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
@@ -400,7 +405,8 @@ def test_whole_tree_zero_nonbaselined_findings():
          str(REPO / "tests" / "fleet_worker.py"),
          str(REPO / "tests" / "test_reshard.py"),
          str(REPO / "tests" / "reshard_worker.py"),
-         str(REPO / "tests" / "test_pool.py")],
+         str(REPO / "tests" / "test_pool.py"),
+         str(REPO / "tests" / "test_tenancy.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

@@ -317,6 +317,18 @@ GOLDEN_EVENT_KEYS = {
                    "total", "burn", "queue_frac", "reason"},
     "pool.failover": {"ev", "ts", "trace", "span", "rid", "model",
                       "from", "to", "attempt"},
+    # GraftPool (round 18): the tenant-arbitration lifecycle — a tenant's
+    # contract admitted onto the pool (once per journal), the throttle
+    # latch firing per excursion (quota/priority/share/backlog pacing),
+    # and a tenant-scoped shed carrying the quota that fired plus the
+    # queue drain estimate the HTTP 429's Retry-After renders
+    # (tenancy/arbiter.py + serving/batcher.py's door shed — same shape)
+    "tenant.admitted": {"ev", "ts", "trace", "span", "tenant", "share",
+                        "priority", "max_inflight", "queue_depth"},
+    "tenant.throttled": {"ev", "ts", "trace", "span", "tenant", "reason",
+                         "waiting", "inflight"},
+    "tenant.shed": {"ev", "ts", "trace", "span", "tenant", "quota",
+                    "waiting", "inflight", "retry_after_ms"},
 }
 
 # GraftFleet (round 15): EVERY journaled event additionally carries the
@@ -419,6 +431,24 @@ def test_golden_event_shapes(tmp_path):
                      burn=1.4, queue_frac=0.6, reason="burn")
         tracer.event("pool.failover", rid="q7", model="naiveBayes",
                      **{"from": "r0", "to": "r1"}, attempt=1)
+        # GraftPool tenant events (round 18) ride their REAL publish
+        # paths: a 1-quota tenant admits on its first slot, a second
+        # same-tenant slot is quota-throttled (spare capacity exists, so
+        # the grant engine observes the pass-over), and its zero-deadline
+        # wait sheds typed — all single-threaded and deterministic
+        from avenir_tpu.serving.errors import TenantShedError
+        from avenir_tpu.tenancy import GraftPool
+        from avenir_tpu.tenancy.contract import TenantContract
+
+        gpool = GraftPool(
+            {"g": TenantContract(tenant="g", share=1.0, max_inflight=1,
+                                 queue_depth=4)}, capacity=2)
+        held = gpool.slot(tenant="g")
+        held.__enter__()
+        with pytest.raises(TenantShedError):
+            with gpool.slot(tenant="g", timeout_s=0):
+                pass
+        held.__exit__(None, None, None)
     path = tracer.journal_path
     tel.tracer().disable()
     seen = {}
