@@ -46,6 +46,13 @@ def main(argv: List[str]) -> int:
         # tunnel, which beats the env var — honor an explicit CPU request
         import jax
         jax.config.update("jax_platforms", "cpu")
+    # CrossGraft: a worker spawned by the fleet launcher (python -m
+    # avenir_tpu.launch) carries its rank in the environment — join the
+    # fleet BEFORE any jax work, through the hardened bounded coordinator
+    # join (a bad coordinator raises a typed LaunchError, never hangs)
+    if os.environ.get("AVENIR_NUM_PROCESSES"):
+        from avenir_tpu.launch import join_from_env
+        join_from_env()
     from avenir_tpu.core.config import JobConfig
     from avenir_tpu.jobs import REGISTRY, get_job
 
@@ -58,6 +65,12 @@ def main(argv: List[str]) -> int:
     conf = JobConfig.from_file(conf_path) if conf_path else JobConfig()
     for k, v in overrides.items():
         conf.set(k, v)
+    # launcher-assigned journal shard suffix: adopted unless the conf
+    # (file or -D) names its own — the per-process trace.writer.suffix
+    # contract the fleet launcher's teardown merge relies on
+    if os.environ.get("AVENIR_WRITER_SUFFIX") and \
+            not conf.get("trace.writer.suffix"):
+        conf.set("trace.writer.suffix", os.environ["AVENIR_WRITER_SUFFIX"])
     if len(positional) != 2:
         raise SystemExit(f"expected <input> <output>, got {positional}")
     job = get_job(job_name)

@@ -14,7 +14,11 @@ Why re-keying is exact: every mesh-qualified entry is a 64-bit HOST
 total that the in-kernel psum already reduced over the source mesh —
 int64 count sums (and the order-exact float64 moment sums the tests
 construct) are mesh-shape-invariant, so an 8-way fold's totals ARE the
-4-way fold's totals byte-for-byte.  The mesh suffix exists to prevent
+4-way fold's totals byte-for-byte.  The same argument covers
+CrossGraft's PROCESS-qualified suffixes (``:mesh:proc2xdata4``): the
+global fold's hierarchical psum already reduced over both axes before
+the host total existed, so a kill-on-2-procs → resume-on-1-proc restore
+re-keys the identical bytes (tests/test_reshard.py cross-process case).  The mesh suffix exists to prevent
 *silent* cross-topology summing, not because the numbers differ; the
 transform moves state across that gate deliberately and journals the
 crossing (``checkpoint.reshard``).
@@ -225,3 +229,19 @@ def journal_reshard(src: str, dst: str, keys: int, directory: str = "",
 def describe(suffix: str) -> str:
     """Human-readable topology name for error messages/logs."""
     return suffix or "unsharded"
+
+
+def suffix_procs(suffix: str) -> int:
+    """The process count a mesh qualifier encodes: ``:mesh:proc2xdata4``
+    → 2 (CrossGraft's global fold), ``:mesh:data8`` / ``""`` → 1.  The
+    transform itself is suffix-OPAQUE (64-bit host totals are
+    mesh-shape-invariant, so re-keying a process-qualified entry moves
+    the same bytes — a kill-on-2-procs → resume-on-1-proc restore is
+    byte-identical by the same argument as 8→4 devices); this parser
+    exists for diagnostics and the journal, not for the algebra."""
+    import re
+
+    if not suffix:
+        return 1
+    m = re.match(rf"{re.escape(MESH_TAG)}([a-z]+)(\d+)x", suffix)
+    return int(m.group(2)) if m else 1

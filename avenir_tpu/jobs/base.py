@@ -947,7 +947,7 @@ class StreamCheckpointer:
         # (the same reason pipeline/scan.py lists it in _COMPAT_KEYS)
         volatile = ("stream.resume", "stream.fault.", "stream.checkpoint.",
                     "stream.prefetch.", "shard.devices", "shard.data.axis",
-                    "shard.reshard.", "shard.skew.", "fault.")
+                    "shard.proc.", "shard.reshard.", "shard.skew.", "fault.")
         stable = sorted(
             (k, v) for k, v in conf.props.items()
             if not any(k == v0.rstrip(".") or k.startswith(v0)

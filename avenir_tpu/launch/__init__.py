@@ -1,0 +1,300 @@
+"""CrossGraft fleet launcher — the process plane under the global mesh.
+
+The reference's N-machine story was Hadoop's: a JobTracker hands map
+tasks to task trackers that some operator already provisioned.  This
+package is that provisioning step for the jax-distributed runtime, in
+one process-shaped verb::
+
+    python -m avenir_tpu.launch --nprocs 2 -- BayesianDistribution \\
+        -Dconf.path=churn.properties train.csv out/
+
+It spawns N local worker processes (or, inside an externally provisioned
+pod, discovers its own rank from the environment and execs the worker in
+place), wires every worker's coordinator join through the HARDENED
+:func:`avenir_tpu.parallel.mesh.init_distributed` (bounded jittered
+retry, typed :class:`LaunchError` naming the coordinator on timeout —
+never a hang), assigns each worker its own journal shard via
+``trace.writer.suffix``/``AVENIR_WRITER_SUFFIX``, and on teardown merges
+the per-process journal shards into one fleet view and propagates the
+first non-zero exit.
+
+Stdlib-only at import time (no jax): the launcher itself must start
+instantly and survive on a machine whose jax is broken — that is
+precisely when its error messages matter.  Workers do the jax work.
+
+Env contract (the worker side reads these; the launcher writes them):
+
+- ``AVENIR_COORDINATOR_ADDRESS`` — ``host:port`` of process 0's
+  coordinator service;
+- ``AVENIR_NUM_PROCESSES`` / ``AVENIR_PROCESS_ID`` — fleet size / rank;
+- ``AVENIR_JOIN_TIMEOUT_SEC`` / ``AVENIR_JOIN_ATTEMPTS`` — the hardened
+  join's bounds (defaults 300 s / 3);
+- ``AVENIR_WRITER_SUFFIX`` — per-process journal-shard suffix
+  (``w<rank>``); ``python -m avenir_tpu`` adopts it as
+  ``trace.writer.suffix`` unless the conf sets one explicitly.
+
+An externally provisioned pod (slurm-style: every rank launched by the
+cluster) sets the same variables per rank and runs the SAME command on
+every rank WITHOUT ``--nprocs``; :func:`pod_env` discovers the rank and
+the launcher execs the worker in place instead of spawning.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+ENV_COORD = "AVENIR_COORDINATOR_ADDRESS"
+ENV_NPROCS = "AVENIR_NUM_PROCESSES"
+ENV_PID = "AVENIR_PROCESS_ID"
+ENV_SUFFIX = "AVENIR_WRITER_SUFFIX"
+ENV_JOIN_TIMEOUT = "AVENIR_JOIN_TIMEOUT_SEC"
+ENV_JOIN_ATTEMPTS = "AVENIR_JOIN_ATTEMPTS"
+
+
+class LaunchError(RuntimeError):
+    """A fleet that could not be brought up or torn down cleanly: a
+    coordinator join that timed out (the message names the coordinator
+    address), a worker that outlived the launch deadline, or an argv the
+    launcher cannot interpret.  Typed so supervisors retry or alert on
+    launch failures distinctly from workload errors."""
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port on localhost — the default
+    coordinator port for locally spawned fleets."""
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def pod_env(environ: Optional[Dict[str, str]] = None) -> Optional[dict]:
+    """Externally provisioned pod discovery: when the environment already
+    names this process's rank (``AVENIR_PROCESS_ID`` + fleet size +
+    coordinator), return ``{"coordinator", "nprocs", "process_id"}``;
+    else None.  This is how one launcher command line works both on a
+    laptop (spawn mode) and under a cluster scheduler that starts every
+    rank itself (join mode)."""
+    env = os.environ if environ is None else environ
+    if ENV_PID not in env or ENV_NPROCS not in env:
+        return None
+    return {"coordinator": env.get(ENV_COORD, ""),
+            "nprocs": int(env[ENV_NPROCS]),
+            "process_id": int(env[ENV_PID])}
+
+
+def join_from_env(environ: Optional[Dict[str, str]] = None) -> int:
+    """Worker-side bootstrap: join the fleet the environment describes
+    (no-op rank 0 of 1 when it describes none) through the hardened
+    coordinator join.  Returns this process's rank.  The ONE call every
+    worker entry point makes before touching jax — ``python -m
+    avenir_tpu`` calls it automatically when ``AVENIR_NUM_PROCESSES`` is
+    set, so any job CLI invocation is fleet-ready."""
+    env = os.environ if environ is None else environ
+    from avenir_tpu.parallel.mesh import init_distributed
+
+    pod = pod_env(env)
+    if pod is None:
+        return init_distributed()          # pod/TPU env discovery inside
+    return init_distributed(
+        coordinator_address=pod["coordinator"] or None,
+        num_processes=pod["nprocs"], process_id=pod["process_id"],
+        timeout_s=float(env.get(ENV_JOIN_TIMEOUT, "300")),
+        attempts=int(env.get(ENV_JOIN_ATTEMPTS, "3")))
+
+
+def worker_command(argv: Sequence[str]) -> List[str]:
+    """The child command line for one worker: ``<JobName> …`` runs the
+    job CLI (``python -m avenir_tpu …``), ``<script>.py …`` runs the
+    script, ``-m <module> …`` runs the module — the three shapes jobs,
+    benchmarks, and tests launch as."""
+    argv = list(argv)
+    if not argv:
+        raise LaunchError("no worker argv after '--': pass the job CLI "
+                          "argv (JobName -D… <in> <out>), a script.py, "
+                          "or -m <module>")
+    if argv[0] == "-m":
+        if len(argv) < 2:
+            raise LaunchError("'-m' needs a module name")
+        return [sys.executable, "-m", argv[1], *argv[2:]]
+    if argv[0].endswith(".py"):
+        return [sys.executable, *argv]
+    return [sys.executable, "-m", "avenir_tpu", *argv]
+
+
+@dataclass
+class WorkerResult:
+    """One worker's teardown record."""
+
+    rank: int
+    returncode: Optional[int]
+    output: str = ""
+    finished_at: float = 0.0
+
+
+@dataclass
+class FleetResult:
+    """What a local launch returned: per-worker records, the propagated
+    exit code (the FIRST non-zero exit in completion order — the worker
+    that died first is the one whose error explains the fleet), and the
+    merged journal path when one was produced."""
+
+    workers: List[WorkerResult] = field(default_factory=list)
+    exit_code: int = 0
+    merged_journal: Optional[str] = None
+
+    def output_of(self, rank: int) -> str:
+        return next(w.output for w in self.workers if w.rank == rank)
+
+
+def merge_fleet_journal(journal_dir: str) -> Optional[str]:
+    """Merge the newest run's per-process journal shards under
+    ``journal_dir`` into one time-ordered ``fleet-<run>.jsonl`` view
+    (``telemetry/journal.py::merge_journals`` — torn tails and missing
+    crashed-worker shards tolerated).  Returns the merged path, or None
+    when the directory holds no shards (tracing was off)."""
+    from avenir_tpu.telemetry.journal import merge_journals
+
+    run_id, shards, events = merge_journals(journal_dir)
+    if run_id is None:
+        return None
+    out_path = os.path.join(journal_dir, f"fleet-{run_id}.jsonl")
+    import json
+
+    with open(out_path, "w", encoding="utf-8") as fh:
+        for e in events:
+            fh.write(json.dumps(e, separators=(",", ":")))
+            fh.write("\n")
+    return out_path
+
+
+def _worker_env(base: Dict[str, str], rank: int, nprocs: int,
+                coordinator: str, devices_per_proc: Optional[int],
+                join_timeout_s: float, join_attempts: int) -> Dict[str, str]:
+    env = dict(base)
+    env[ENV_COORD] = coordinator
+    env[ENV_NPROCS] = str(nprocs)
+    env[ENV_PID] = str(rank)
+    env[ENV_SUFFIX] = f"w{rank}"
+    env[ENV_JOIN_TIMEOUT] = str(join_timeout_s)
+    env[ENV_JOIN_ATTEMPTS] = str(join_attempts)
+    if devices_per_proc:
+        # host-mesh workers: K virtual CPU devices each (the tier-1
+        # trick per process); strip any inherited forced count first so
+        # the worker's mesh is exactly K wide
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(
+            f"--xla_force_host_platform_device_count={devices_per_proc}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def launch_local(child_argv: Sequence[str], nprocs: int, *,
+                 devices_per_proc: Optional[int] = None,
+                 coordinator: Optional[str] = None,
+                 join_timeout_s: float = 300.0, join_attempts: int = 3,
+                 timeout_s: float = 0.0, grace_s: float = 15.0,
+                 env: Optional[Dict[str, str]] = None,
+                 journal_dir: Optional[str] = None,
+                 echo: bool = True) -> FleetResult:
+    """Spawn ``nprocs`` local workers running ``child_argv`` (see
+    :func:`worker_command`) as one jax-distributed fleet and tear it
+    down: stream every worker's output (prefixed ``[p<k>]``), enforce
+    the optional wall deadline (``timeout_s`` > 0 — expiry kills the
+    fleet and raises :class:`LaunchError`), give surviving workers
+    ``grace_s`` to notice a dead peer before killing them (the
+    coordinator's health check is not instant), merge journal shards
+    from ``journal_dir`` when given, and propagate the first non-zero
+    exit in completion order."""
+    import subprocess
+
+    if nprocs < 1:
+        raise LaunchError(f"--nprocs must be >= 1, got {nprocs}")
+    cmd = worker_command(child_argv)
+    coordinator = coordinator or f"localhost:{free_port()}"
+    base_env = dict(os.environ if env is None else env)
+    procs = []
+    for rank in range(nprocs):
+        wenv = _worker_env(base_env, rank, nprocs, coordinator,
+                           devices_per_proc, join_timeout_s, join_attempts)
+        procs.append(subprocess.Popen(
+            cmd, env=wenv, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+
+    outputs: List[List[str]] = [[] for _ in range(nprocs)]
+    lock = threading.Lock()
+
+    def pump(rank: int) -> None:
+        for line in procs[rank].stdout:
+            outputs[rank].append(line)
+            if echo:
+                with lock:
+                    sys.stdout.write(f"[p{rank}] {line}")
+                    sys.stdout.flush()
+
+    readers = [threading.Thread(target=pump, args=(r,), daemon=True)
+               for r in range(nprocs)]
+    for t in readers:
+        t.start()
+
+    deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+    finished: Dict[int, float] = {}
+    first_failure_at: Optional[float] = None
+    try:
+        while len(finished) < nprocs:
+            now = time.monotonic()
+            for rank, p in enumerate(procs):
+                if rank not in finished and p.poll() is not None:
+                    finished[rank] = now
+                    if p.returncode != 0 and first_failure_at is None:
+                        first_failure_at = now
+            if len(finished) == nprocs:
+                break
+            if deadline is not None and now > deadline:
+                for p in procs:
+                    p.kill()
+                raise LaunchError(
+                    f"fleet launch exceeded the {timeout_s:g}s deadline; "
+                    f"still running: "
+                    f"{sorted(set(range(nprocs)) - set(finished))} — "
+                    f"workers killed")
+            if first_failure_at is not None and \
+                    now - first_failure_at > grace_s:
+                # a worker died and its peers did not follow within the
+                # grace window (wedged in a collective the dead peer will
+                # never enter): kill the stragglers, keep their output
+                for rank, p in enumerate(procs):
+                    if rank not in finished:
+                        p.kill()
+            time.sleep(0.05)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in readers:
+            t.join(timeout=10)
+
+    result = FleetResult()
+    order = sorted(range(nprocs), key=lambda r: finished.get(r, float("inf")))
+    for rank in range(nprocs):
+        result.workers.append(WorkerResult(
+            rank=rank, returncode=procs[rank].returncode,
+            output="".join(outputs[rank]),
+            finished_at=finished.get(rank, 0.0)))
+    for rank in order:                       # first non-zero IN TIME ORDER
+        rc = procs[rank].returncode
+        if rc:
+            result.exit_code = int(rc)
+            break
+    if journal_dir:
+        result.merged_journal = merge_fleet_journal(journal_dir)
+    return result

@@ -14,7 +14,7 @@ validate multi-chip compilation on a virtual device mesh.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -267,7 +267,8 @@ def quantized_allreduce_sum(x: jax.Array, axis_name: str) -> jax.Array:
 def sharded_scan_step(mesh: Mesh, num_bins: int, num_classes: int,
                       data_axis: str = "data", interpret: bool = False,
                       block_cols=None, quantized: bool = False,
-                      moments: bool = True):
+                      moments: bool = True,
+                      proc_axis: Optional[str] = None):
     """THE ShardGraft SharedScan dispatch (round 12): per-device Pallas
     co-occurrence gram + class counts + class moments of ONE data-sharded
     chunk, all-reduced over the mesh's data axis inside the compiled
@@ -293,25 +294,49 @@ def sharded_scan_step(mesh: Mesh, num_bins: int, num_classes: int,
     :func:`quantized_allreduce_sum`; class counts and moments stay on the
     exact psum either way.
 
+    CrossGraft (``proc_axis`` set): the GLOBAL form over a (proc × data)
+    hybrid mesh — the batch axis sharded over BOTH axes, the gram
+    reduced HIERARCHICALLY inside the same fused dispatch: ``psum`` over
+    ``data`` first (the within-host ICI leg, always exact — the cheap
+    hop carries full precision), then over ``proc`` (the cross-host DCN
+    leg; under ``quantized`` THIS leg rides the EQuARX-style int8
+    collective, because DCN — not ICI — is where the bytes hurt, arXiv
+    2506.17615).  The DrJAX mapreduce decomposition (arXiv 2403.07128):
+    per-host map + hierarchical reduce, one compiled program.  Counts
+    and moments psum over both axes exactly.
+
     Memoized on the full signature (``Mesh`` is hashable): every
     ``ChunkFolder`` construction — one per ``SharedScan.run`` — reuses the
     SAME jitted program, so a warm pass warms all later runs in the
     process instead of each run paying a fresh trace+compile."""
     from avenir_tpu.ops import pallas_hist
 
+    batch_axes = (data_axis if proc_axis is None
+                  else (proc_axis, data_axis))
+
     def step(codes, labels, cont):
         _check_chunk(codes)        # per-shard f32 exact-accumulation cap
         g = pallas_hist.cooc_counts.__wrapped__(
             codes, labels, num_bins, num_classes, interpret=interpret,
             block_cols=block_cols)
-        if quantized:
-            g = jnp.round(quantized_allreduce_sum(
-                g, data_axis)).astype(jnp.int32)
+        if proc_axis is None:
+            if quantized:
+                g = jnp.round(quantized_allreduce_sum(
+                    g, data_axis)).astype(jnp.int32)
+            else:
+                g = jax.lax.psum(g, data_axis)
         else:
+            # hierarchical: exact within-host psum, then the cross-host
+            # leg — quantized only here, where the wire is DCN
             g = jax.lax.psum(g, data_axis)
+            if quantized:
+                g = jnp.round(quantized_allreduce_sum(
+                    g, proc_axis)).astype(jnp.int32)
+            else:
+                g = jax.lax.psum(g, proc_axis)
         oh_c = _onehot(labels, num_classes)                    # [n_loc, C]
         cnt = jnp.sum(oh_c, axis=0)                            # exact f32
-        cc = jax.lax.psum(cnt.astype(jnp.int32), data_axis)
+        cc = jax.lax.psum(cnt.astype(jnp.int32), batch_axes)
         if not moments:
             # count-only consumer sets skip the moment einsums + psums
             # entirely (the single-chip kernel path makes the same cut)
@@ -320,14 +345,14 @@ def sharded_scan_step(mesh: Mesh, num_bins: int, num_classes: int,
         s2 = jnp.einsum("nc,nf->cf", oh_c, cont * cont,
                         precision="highest")
         return (g, cc,
-                jax.lax.psum(cnt, data_axis),
-                jax.lax.psum(s1, data_axis),
-                jax.lax.psum(s2, data_axis))
+                jax.lax.psum(cnt, batch_axes),
+                jax.lax.psum(s1, batch_axes),
+                jax.lax.psum(s2, batch_axes))
 
     # norep: pallas_call outputs don't carry varying-mesh-axis metadata
     wrapped = _shard_map_norep(
         step, mesh,
-        (P(data_axis, None), P(data_axis), P(data_axis, None)),
+        (P(batch_axes, None), P(batch_axes), P(batch_axes, None)),
         (P(),) * (5 if moments else 2))
     return jax.jit(wrapped)
 

@@ -118,9 +118,64 @@ def device_put_sharded_batch(mesh: Mesh, *arrays, data_axis: str = "data"):
 # multi-host (DCN) support
 # ---------------------------------------------------------------------------
 
+# the last successful coordinator join of THIS process (CrossGraft): the
+# tracer is usually not configured yet when init_distributed runs (the
+# join must precede any jax work, configuration follows), so the join
+# facts are recorded here and announced into the journal later by the
+# seams that know the journal exists (ShardSpec.announce / the launcher)
+_LAST_JOIN: Optional[dict] = None
+
+
+def last_join() -> Optional[dict]:
+    """The recorded ``fleet.join`` payload of this process's coordinator
+    join, or None when the process never joined (single-process run)."""
+    return _LAST_JOIN
+
+
+def journal_fleet_join(coordinator: str, nprocs: int, attempts: int,
+                       wall_ms: float) -> None:
+    """Journal one golden-schema'd ``fleet.join`` event (the worker's
+    cluster-join record: coordinator address, fleet size, how many join
+    attempts it took, and the join wall time) — proc/host identity rides
+    the GraftFleet stamp every record carries.  At most once per journal
+    per coordinator: the join-time emission (usually a no-op — tracing
+    is rarely configured that early) and the later ``announce`` replay
+    share the dedupe key."""
+    from avenir_tpu.telemetry import spans as tel
+
+    tel.tracer().event_once("fleet.join", str(coordinator),
+                            coordinator=coordinator,
+                            nprocs=int(nprocs), attempts=int(attempts),
+                            wall_ms=round(float(wall_ms), 3))
+
+
+def _enable_cpu_collectives() -> None:
+    """Arm the CPU backend's cross-process collective transport (gloo)
+    BEFORE the backend is created.  Without it every cross-process
+    computation on a multi-process CPU runtime dies with XLA's
+    'Multiprocess computations aren't implemented on the CPU backend' —
+    the root cause of the long-standing multiprocess-env tier-1 failures
+    this round retired.  No-op off-CPU and on jax builds without the
+    option; harmless when already set."""
+    import os as _os
+
+    platforms = (_os.environ.get("JAX_PLATFORMS", "")
+                 or str(jax.config.jax_platforms or ""))
+    if platforms.split(",")[0].strip().lower() not in ("cpu", ""):
+        return
+    try:
+        if getattr(jax.config, "jax_cpu_collectives_implementation",
+                   None) in (None, "", "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:                              # pragma: no cover
+        pass                    # older jax: option absent; TPU paths unaffected
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> int:
+                     process_id: Optional[int] = None,
+                     timeout_s: Optional[float] = None,
+                     attempts: Optional[int] = None) -> int:
     """Join a multi-host run (the analog of the reference's cluster join —
     its JobTracker/Storm nimbus handshake, SURVEY.md §5 'distributed
     communication backend').
@@ -129,7 +184,28 @@ def init_distributed(coordinator_address: Optional[str] = None,
     discovered from the environment, elsewhere pass the coordinator
     explicitly. Idempotent; returns this host's process index. Single-host
     runs skip initialization entirely.
+
+    Hardened (CrossGraft): the join is BOUNDED.  A non-zero rank first
+    PROBES the coordinator's TCP endpoint under the ``utils/retry``
+    decorrelated-jitter backoff (so N workers re-arriving spread out
+    instead of thundering in lockstep) for up to ``timeout_s`` (default
+    300 s, ``AVENIR_JOIN_TIMEOUT_SEC``); an unreachable/refused address
+    raises a typed :class:`~avenir_tpu.launch.LaunchError` NAMING the
+    coordinator — the probe exists because jax's own client ABORTS the
+    process (LOG(FATAL) on RegisterTask deadline) rather than raising,
+    so the typed error must fire before jax ever connects.  The
+    initialize itself then carries ``initialization_timeout`` and
+    retries up to ``attempts`` times (default 3,
+    ``AVENIR_JOIN_ATTEMPTS``) on transient service errors.  The CPU
+    gloo collective transport is armed before the backend exists
+    (:func:`_enable_cpu_collectives` — without it every cross-process
+    CPU computation dies), and the join is recorded for the journal
+    (:func:`last_join` → ``fleet.join``, emitted immediately too when
+    tracing is already on).
     """
+    import os as _os
+    import time as _time
+
     # Probe the distributed-client state WITHOUT touching the backend:
     # jax.process_count() would itself initialize a single-process backend,
     # after which jax.distributed.initialize always fails — the join must
@@ -141,24 +217,134 @@ def init_distributed(coordinator_address: Optional[str] = None,
         already = False
     if already:
         return jax.process_index()          # already joined
+    env = _os.environ
     if coordinator_address is None and num_processes is None:
-        env = __import__("os").environ
         if not any(k in env for k in
                    ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-                    "MEGASCALE_COORDINATOR_ADDRESS")):
+                    "MEGASCALE_COORDINATOR_ADDRESS",
+                    "AVENIR_COORDINATOR_ADDRESS")):
             return 0                        # single host, nothing to join
+        coordinator_address = (
+            coordinator_address or env.get("AVENIR_COORDINATOR_ADDRESS"))
+        if env.get("AVENIR_NUM_PROCESSES"):
+            num_processes = int(env["AVENIR_NUM_PROCESSES"])
+        if env.get("AVENIR_PROCESS_ID"):
+            process_id = int(env["AVENIR_PROCESS_ID"])
+    _enable_cpu_collectives()
+    if attempts is None:
+        attempts = int(env.get("AVENIR_JOIN_ATTEMPTS", "3"))
+    if timeout_s is None:
+        timeout_s = float(env.get("AVENIR_JOIN_TIMEOUT_SEC", "300"))
+    from avenir_tpu.utils.retry import RetryPolicy
+
+    policy = RetryPolicy(max_attempts=max(int(attempts), 1), backoff_s=0.5)
+    t0 = _time.monotonic()
+    if process_id not in (None, 0) and coordinator_address:
+        # rank 0 BINDS the address (nothing to probe); every other rank
+        # waits for it to become reachable within the bounded window
+        _wait_for_coordinator(str(coordinator_address), float(timeout_s))
+    last_err: Optional[BaseException] = None
+    sleep_s = 0.0
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=max(int(timeout_s), 1))
+            _record_join(coordinator_address, attempt,
+                         (_time.monotonic() - t0) * 1e3)
+            return jax.process_index()
+        except RuntimeError as e:
+            if "before" in str(e) and "initialize" in str(e):
+                # backend already initialized: a single-host run that
+                # touched a device before calling in, or a repeat call in
+                # an already-joined process (e.g. if the private-state
+                # probe above broke on a JAX upgrade).  process_index()
+                # reports the truth — never assume rank 0.
+                return jax.process_index()
+            last_err = e
+        except ValueError:
+            raise                          # malformed arguments: fail fast
+        except Exception as e:             # timeout / connect failure
+            last_err = e
+        try:                               # clear any half-joined state
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        if attempt < policy.max_attempts:
+            sleep_s = policy.next_backoff(sleep_s)
+            _time.sleep(sleep_s)
+    from avenir_tpu.launch import LaunchError
+
+    raise LaunchError(
+        f"fleet join failed: coordinator {coordinator_address!r} "
+        f"(process {process_id} of {num_processes}) did not accept the "
+        f"join within {timeout_s:g}s on any of {policy.max_attempts} "
+        f"attempt(s) — check the coordinator address/port and that "
+        f"process 0 is up: {last_err!r}") from last_err
+
+
+def _wait_for_coordinator(address: str, timeout_s: float) -> None:
+    """Bounded, jittered wait for the coordinator's TCP endpoint.
+
+    Retries a plain socket connect under the decorrelated-jitter backoff
+    (``utils/retry.RetryPolicy.next_backoff`` — base 0.2 s) until the
+    endpoint accepts or ``timeout_s`` expires, then raises the typed
+    :class:`~avenir_tpu.launch.LaunchError` naming the address.  This
+    runs BEFORE ``jax.distributed.initialize`` because jax's client
+    terminates the process outright (abort, not an exception) when its
+    RegisterTask RPC times out — the pre-flight probe is the only place
+    a bad coordinator address can fail typed."""
+    import socket as _socket
+    import time as _time
+
+    from avenir_tpu.utils.retry import RetryPolicy
+
+    host, _, port_s = address.rpartition(":")
     try:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
-    except RuntimeError:
-        # backend already initialized: either a single-host run that
-        # touched a device before calling in, or a repeated call in an
-        # already-joined process (e.g. if the private-state probe above
-        # broke on a JAX upgrade). process_index() reports the truth in
-        # both cases — never assume rank 0.
-        return jax.process_index()
-    return jax.process_index()
+        port = int(port_s)
+    except ValueError:
+        from avenir_tpu.launch import LaunchError
+
+        raise LaunchError(
+            f"coordinator address {address!r} is not host:port")
+    policy = RetryPolicy(max_attempts=1, backoff_s=0.2, backoff_cap_s=2.0)
+    deadline = _time.monotonic() + max(float(timeout_s), 0.1)
+    sleep_s = 0.0
+    last: Optional[BaseException] = None
+    while True:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            from avenir_tpu.launch import LaunchError
+
+            raise LaunchError(
+                f"fleet join failed: coordinator {address!r} was not "
+                f"reachable within {timeout_s:g}s — check the address/"
+                f"port and that process 0 (the coordinator) is up: "
+                f"{last!r}") from last
+        try:
+            sock = _socket.create_connection(
+                (host or "localhost", port),
+                timeout=min(2.0, max(remaining, 0.1)))
+            sock.close()
+            return
+        except OSError as e:
+            last = e
+        sleep_s = min(policy.next_backoff(sleep_s),
+                      max(deadline - _time.monotonic(), 0.0))
+        _time.sleep(sleep_s)
+
+
+def _record_join(coordinator, attempts: int, wall_ms: float) -> None:
+    """Record (and, when tracing is already configured, journal) this
+    process's successful coordinator join."""
+    global _LAST_JOIN
+    _LAST_JOIN = {"coordinator": str(coordinator or "env-discovered"),
+                  "nprocs": int(jax.process_count()),
+                  "attempts": int(attempts),
+                  "wall_ms": round(float(wall_ms), 3)}
+    journal_fleet_join(**_LAST_JOIN)       # no-op until tracing is on
 
 
 def make_hybrid_mesh(

@@ -30,9 +30,21 @@ out):
    different device count / axis name fails loudly at read-out instead of
    folding stale counts (the GL002 discipline applied to topology).
 
-Single-process only, like ``Job.auto_mesh``: multi-host runs partition
-chunks per process and merge through ``all_process_sum_state`` — the two
-composability seams are documented in docs/architecture.md (ShardGraft).
+CrossGraft (this round) lifts the old single-process refusal: under
+``jax.process_count() > 1`` the SAME ``shard.*`` family resolves to a
+GLOBAL hybrid mesh — a leading process axis (``shard.proc.axis``,
+default ``proc``) across the DCN/process boundary × ``shard.devices``
+local devices per process on ICI.  Chunks enter per-process (each
+process uploads only ITS contiguous row block of the padded chunk via
+``jax.make_array_from_process_local_data`` — the ``process_local_batch``
+recipe under the 2-D layout), the fused dispatch psums the gram within a
+host over ``data`` and then across hosts over ``proc`` (exact psum; the
+EQuARX-style int8 hop rides the CROSS-HOST leg only, where DCN — not
+ICI — is the bottleneck, arXiv 2506.17615), and the ``g:`` qualifier
+gains the process topology (``:mesh:proc2xdata4``) so stale-topology
+folds still refuse loudly.  Finalize stays on the data-free
+constructors, so the N-process × M-device fold is byte-identical to the
+1-chip oracle by construction (tests/crossgraft_worker.py).
 """
 
 from __future__ import annotations
@@ -52,9 +64,17 @@ class ShardSpec:
     through ``SharedScan``/``ChunkFolder``/``WindowedScan`` and the chunk
     feeder so every seam stages and folds under the SAME topology."""
 
-    mesh: object                      # jax.sharding.Mesh (1-D data mesh)
+    mesh: object                      # jax.sharding.Mesh (1-D data mesh,
+    #                                   or (proc, data) global hybrid mesh)
     data_axis: str = "data"
     quantized: bool = False
+    # CrossGraft (this round): >1 means the mesh is the GLOBAL hybrid
+    # mesh — a leading process axis across the DCN/process boundary, the
+    # data axis within each host on ICI.  1 = the round-12 local plan,
+    # byte-for-byte (no proc axis anywhere in mesh, key, or dispatch).
+    proc_axis: str = "proc"
+    num_procs: int = 1
+    proc_index: int = 0
     # GraftFleet straggler attribution (round 15; parallel/skew.py —
     # active only under profile.on): sampled per-device wall probe around
     # the fused fold, flagging chunks whose max/min per-device time
@@ -77,21 +97,19 @@ class ShardSpec:
     @classmethod
     def from_conf(cls, conf) -> Optional["ShardSpec"]:
         """The ``shard.*`` config family → a spec, or None when unset
-        (today's single-chip path, exactly).  Refuses impossible requests
-        loudly: more devices than attached, a multi-process run (chunk
-        ownership is per-process there — ``all_process_sum_state`` is the
-        cross-host reduce), or a non-positive count."""
+        (today's single-chip path, exactly).  In a multi-process run
+        (CrossGraft) ``shard.devices`` counts PER-PROCESS devices and the
+        spec resolves to the global (proc × data) hybrid mesh.  Refuses
+        genuinely impossible requests loudly: more devices than any
+        process has locally attached, a process axis named like the data
+        axis, or a non-positive/unparsable count."""
         if not cls.requested(conf):
             return None
         raw = conf.get("shard.devices")
         import jax
 
-        if jax.process_count() > 1:
-            raise ConfigError(
-                "shard.devices is single-process (it places globally-"
-                "addressed arrays); multi-host runs partition chunks per "
-                "process and merge via all_process_sum_state instead")
-        avail = jax.devices()
+        nprocs = jax.process_count()
+        avail = jax.local_devices() if nprocs > 1 else jax.devices()
         try:
             n = len(avail) if str(raw).strip().lower() == "all" else int(raw)
         except ValueError:
@@ -101,33 +119,92 @@ class ShardSpec:
             raise ConfigError(f"shard.devices={raw!r} must be >= 1 or 'all'")
         if n > len(avail):
             raise ConfigError(
-                f"shard.devices={n} but only {len(avail)} device(s) "
-                f"attached ({avail[0].platform})")
+                f"shard.devices={n} but only {len(avail)} "
+                + ("locally-attached " if nprocs > 1 else "")
+                + f"device(s) "
+                + (f"on process {jax.process_index()} " if nprocs > 1
+                   else "")
+                + f"attached ({avail[0].platform})"
+                + (" — in a multi-process run shard.devices counts "
+                   "per-process devices" if nprocs > 1 else ""))
         axis = conf.get("shard.data.axis", "data")
+        quantized = conf.get_bool("shard.allreduce.quantized", False)
+        skew = dict(
+            skew_threshold=conf.get_float("shard.skew.threshold", 1.5),
+            skew_sample=conf.get_int("shard.skew.sample", 1),
+            skew_fault_device=conf.get_int("shard.skew.fault.device", -1),
+            skew_fault_ms=conf.get_float("shard.skew.fault.ms", 0.0))
+        if nprocs > 1:
+            proc_axis = conf.get("shard.proc.axis", "proc")
+            if proc_axis == axis:
+                raise ConfigError(
+                    f"shard.proc.axis={proc_axis!r} collides with "
+                    f"shard.data.axis — the global mesh needs two distinct "
+                    f"axis names")
+            return cls(mesh=cls._global_mesh(proc_axis, axis, n),
+                       data_axis=axis, quantized=quantized,
+                       proc_axis=proc_axis, num_procs=nprocs,
+                       proc_index=jax.process_index(), **skew)
         from avenir_tpu.parallel.mesh import make_mesh
 
         return cls(mesh=make_mesh((axis,), shape=(n,), devices=avail[:n]),
-                   data_axis=axis,
-                   quantized=conf.get_bool("shard.allreduce.quantized",
-                                           False),
-                   skew_threshold=conf.get_float("shard.skew.threshold",
-                                                 1.5),
-                   skew_sample=conf.get_int("shard.skew.sample", 1),
-                   skew_fault_device=conf.get_int("shard.skew.fault.device",
-                                                  -1),
-                   skew_fault_ms=conf.get_float("shard.skew.fault.ms", 0.0))
+                   data_axis=axis, quantized=quantized, **skew)
+
+    @staticmethod
+    def _global_mesh(proc_axis: str, data_axis: str, n: int):
+        """The (nprocs × n) global hybrid mesh: leading axis spans
+        processes (the DCN boundary), trailing axis the first ``n``
+        devices OF EACH process (ICI) — the ``make_hybrid_mesh`` layout,
+        built explicitly so a run may use fewer than all local devices.
+        Every process constructs the identical mesh (devices sorted by
+        (process, id)), which SPMD dispatch requires."""
+        import jax
+        from jax.sharding import Mesh
+
+        by_proc: dict = {}
+        for d in sorted(jax.devices(), key=lambda d: (d.process_index, d.id)):
+            by_proc.setdefault(d.process_index, []).append(d)
+        nprocs = jax.process_count()
+        short = min(len(v) for v in by_proc.values())
+        if n > short:
+            raise ConfigError(
+                f"shard.devices={n} but the smallest process has only "
+                f"{short} device(s) — the global mesh needs n devices on "
+                f"EVERY process")
+        arr = np.array([by_proc[p][:n] for p in sorted(by_proc)],
+                       dtype=object)
+        assert arr.shape == (nprocs, n)      # one row per process
+        return Mesh(arr, (proc_axis, data_axis))
 
     # -- identity -------------------------------------------------------------
     @property
     def num_devices(self) -> int:
+        """Data-axis width: per-process device count on a global mesh."""
         return int(self.mesh.shape[self.data_axis])
+
+    @property
+    def total_devices(self) -> int:
+        """Every device the plan folds over, fleet-wide."""
+        return self.num_procs * self.num_devices
+
+    @property
+    def is_global(self) -> bool:
+        """Does the plan span processes (CrossGraft hybrid mesh)?"""
+        return self.num_procs > 1
 
     @property
     def g_suffix(self) -> str:
         """Mesh-shape qualifier appended to the gram accumulator key: a
-        resharded run (different device count or axis name) reads a
-        DIFFERENT key, and ``ChunkFolder.tables`` raises on the orphaned
-        one — stale topology state can never be silently summed."""
+        resharded run (different device count, process count, or axis
+        name) reads a DIFFERENT key, and ``ChunkFolder.tables`` raises on
+        the orphaned one — stale topology state can never be silently
+        summed.  A global plan's qualifier carries the PROCESS topology
+        too (``:mesh:proc2xdata4``), so a 2-proc fold resumed on 1 proc
+        crosses the same loud gate (checkpoint/reshard redistributes it
+        under ``shard.reshard.on.restore``)."""
+        if self.is_global:
+            return (f":mesh:{self.proc_axis}{self.num_procs}"
+                    f"x{self.data_axis}{self.num_devices}")
         return f":mesh:{self.data_axis}{self.num_devices}"
 
     def device_kind(self) -> str:
@@ -138,7 +215,7 @@ class ShardSpec:
     def pad_target(self, n: int) -> int:
         from avenir_tpu.parallel.mesh import shard_pad_target
 
-        return shard_pad_target(n, self.num_devices)
+        return shard_pad_target(n, self.total_devices)
 
     def stage(self, ds):
         """Ballast-pad an encoded chunk to its pow-2 shard target and place
@@ -167,29 +244,74 @@ class ShardSpec:
     def shard_batch(self, codes, labels, cont):
         """Array-level staging (the fold-side entry): ballast-pad host
         arrays to the shard target, then place over the data axis; arrays
-        already carrying this mesh's batch sharding pass through."""
+        already carrying this mesh's batch sharding pass through.
+
+        Global plans stage PER PROCESS: the pad target covers the whole
+        fleet (pow-2 rounded to a ``nprocs × n`` multiple — identical on
+        every process by construction), each process slices ITS
+        contiguous row block of the padded chunk, and
+        ``jax.make_array_from_process_local_data`` assembles the
+        globally-sharded batch without moving a byte cross-host — the
+        ``process_local_batch`` recipe under the (proc, data) layout."""
         import jax
 
         from avenir_tpu.parallel.mesh import maybe_shard_batch, pad_batch
 
-        if not isinstance(codes, jax.Array):
-            n = codes.shape[0]
-            codes, labels, cont = pad_batch(self.pad_target(n), codes,
-                                            labels, cont)
-        return maybe_shard_batch(self.mesh, codes, labels, cont,
-                                 data_axis=self.data_axis)
+        if not self.is_global:
+            if not isinstance(codes, jax.Array):
+                n = codes.shape[0]
+                codes, labels, cont = pad_batch(self.pad_target(n), codes,
+                                                labels, cont)
+            return maybe_shard_batch(self.mesh, codes, labels, cont,
+                                     data_axis=self.data_axis)
+        if isinstance(codes, jax.Array):
+            # staged already (the sharded prefetch path ran this on its
+            # worker thread); a foreign placement cannot be resharded
+            # cross-process, so refuse instead of silently mislaying
+            from jax.sharding import NamedSharding
+
+            sh = codes.sharding
+            if not (isinstance(sh, NamedSharding) and sh.mesh == self.mesh):
+                raise ConfigError(
+                    "chunk arrays are device-placed under a different "
+                    "mesh than this global shard plan — stage host arrays "
+                    "through ShardSpec.stage/shard_batch instead")
+            return [codes, labels, cont]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        target = self.pad_target(codes.shape[0])
+        codes, labels, cont = pad_batch(target, codes, labels, cont)
+        per = target // self.num_procs
+        lo = self.proc_index * per
+        axes = (self.proc_axis, self.data_axis)
+        out = []
+        for a in (codes, labels, cont):
+            if a is None:
+                out.append(None)
+                continue
+            spec = P(axes, *([None] * (a.ndim - 1)))
+            out.append(jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, spec),
+                np.ascontiguousarray(a[lo:lo + per])))
+        return out
 
     # -- telemetry ------------------------------------------------------------
     def announce(self, tracer=None) -> dict:
         """Journal the run's hardware identity (``shard.topology``: device
-        kind, mesh shape, axis names) so any bench/journal artifact is
-        self-describing about what it ran on; returns the payload for
-        callers embedding it in their own artifacts."""
+        kind, mesh shape, axis names, process count) so any bench/journal
+        artifact is self-describing about what it ran on; returns the
+        payload for callers embedding it in their own artifacts.  On a
+        global plan ``devices`` counts the WHOLE fleet and the mesh/axes
+        carry the process axis — the per-run topology record the
+        acceptance gate reads.  A multi-process worker also announces its
+        coordinator join here (``fleet.join``, recorded by
+        ``init_distributed`` before any journal existed)."""
         topo = {
-            "devices": self.num_devices,
+            "devices": self.total_devices,
             "device_kind": self.device_kind(),
             "mesh": {k: int(v) for k, v in self.mesh.shape.items()},
             "axes": list(self.mesh.axis_names),
+            "procs": self.num_procs,
         }
         if tracer is None:
             from avenir_tpu.telemetry import spans as tel
@@ -200,4 +322,9 @@ class ShardSpec:
         # carry ONE hardware identity — a run mixing topologies (distinct
         # shard.* stage props) still journals each distinct one
         tracer.event_once("shard.topology", self.g_suffix, **topo)
+        from avenir_tpu.parallel import mesh as pmesh
+
+        join = pmesh.last_join()
+        if join is not None:
+            pmesh.journal_fleet_join(**join)
         return topo

@@ -58,19 +58,27 @@ from typing import List, Optional
 @functools.lru_cache(maxsize=32)
 def skew_probe_step(mesh, num_bins: int, num_classes: int,
                     data_axis: str = "data", interpret: bool = False,
-                    block_cols=None):
+                    block_cols=None, proc_axis=None):
     """The per-device timing probe: each device runs the SAME local gram
     pass as ``sharded_scan_step`` (identical kernel + shapes, so its wall
     is representative) reduced to one scalar per device, with NO
     cross-device collective and the [D] output sharded over the data
     axis — shard *d* is ready exactly when device *d* is done.  Memoized
-    like the fused step, so repeated folds reuse the compiled probe."""
+    like the fused step, so repeated folds reuse the compiled probe.
+
+    CrossGraft: on a global (proc × data) mesh pass ``proc_axis`` — the
+    batch and the [D] output shard over BOTH axes, and each process
+    observes its ADDRESSABLE shards (its own devices); cross-process
+    attribution composes in the merged fleet journal, where every
+    process's ``shard.skew`` events carry its proc stamp."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from avenir_tpu.ops import pallas_hist
     from avenir_tpu.parallel.collectives import _shard_map_norep
+
+    axes = data_axis if proc_axis is None else (proc_axis, data_axis)
 
     def step(codes, labels):
         g = pallas_hist.cooc_counts.__wrapped__(
@@ -80,8 +88,8 @@ def skew_probe_step(mesh, num_bins: int, num_classes: int,
         return jnp.sum(g, dtype=jnp.int32).reshape(1)
 
     wrapped = _shard_map_norep(step, mesh,
-                               (P(data_axis, None), P(data_axis)),
-                               P(data_axis))
+                               (P(axes, None), P(axes)),
+                               P(axes))
     return jax.jit(wrapped)
 
 
@@ -139,9 +147,10 @@ class DeviceSkewProbe:
         self.counters = counters
         self.threshold = float(spec.skew_threshold)
         self.sample_every = max(int(spec.skew_sample), 1)
-        self.step = skew_probe_step(spec.mesh, num_bins, num_classes,
-                                    data_axis=spec.data_axis,
-                                    interpret=interpret)
+        self.step = skew_probe_step(
+            spec.mesh, num_bins, num_classes, data_axis=spec.data_axis,
+            interpret=interpret,
+            proc_axis=spec.proc_axis if spec.is_global else None)
         self._n = 0
 
     def maybe_probe(self, codes, labels) -> Optional[dict]:

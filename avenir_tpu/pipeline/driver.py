@@ -205,9 +205,12 @@ class Pipeline:
             run_attrs["reshard.on.restore"] = True
         with tel.label_scope(tenant=tenant), \
                 tracer.span("pipeline.run", attrs=run_attrs):
-            # ShardGraft (round 12): resolve the shard.* topology once at
-            # run start so an impossible request (more devices than
-            # attached, multi-process) fails HERE, before any stage runs.
+            # ShardGraft (round 12) / CrossGraft (this round): resolve
+            # the shard.* topology once at run start so a genuinely
+            # impossible request (more devices than any process has
+            # attached, colliding axis names) fails HERE, before any
+            # stage runs; a multi-process runtime resolves to the global
+            # (proc × data) hybrid mesh instead of refusing.
             # The journal's shard.topology event is emitted by the seams
             # that actually fold sharded (run_fused_stages, the streaming
             # job) — announce() dedupes per journal — so the artifact

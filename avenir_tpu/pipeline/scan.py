@@ -314,7 +314,11 @@ class ChunkFolder:
                     shard.mesh, b, c, data_axis=shard.data_axis,
                     interpret=not pallas_hist.mesh_on_tpu(shard.mesh),
                     quantized=shard.quantized,
-                    moments=self.needs_moments)
+                    moments=self.needs_moments,
+                    # CrossGraft: a global plan reduces hierarchically —
+                    # psum within the host, then the cross-process leg —
+                    # inside the SAME fused dispatch
+                    proc_axis=shard.proc_axis if shard.is_global else None)
                 self.step = "shard"
             elif pallas_hist.use_kernel(f, b, c, mesh=self.mesh):
                 self.step = "kernel"
@@ -332,14 +336,25 @@ class ChunkFolder:
             shard.g_suffix if self.step == "shard" else "")
         # logical all-reduce payload per fused shard dispatch (telemetry):
         # the gram (int8+scales when quantized, int32 psum otherwise) plus
-        # the class-count/moment psums
+        # the class-count/moment psums.  A global plan pays TWO legs —
+        # the exact within-host psum plus the cross-process hop (int8
+        # when quantized — only that leg rides the lossy collective), so
+        # the counter reports the sum of both legs' logical payloads.
         if self.step == "shard":
             mode, _, wp = pallas_hist.plan(f, b, c)
             cells = (c * wp * wp) if mode in ("cls", "clsb") else (wp * wp)
             rows = cells // wp
-            gbytes = (cells + 4 * rows if shard.quantized else 4 * cells)
-            self._collective_bytes = gbytes + 4 * c * (
-                2 + 2 * meta.num_cont if self.needs_moments else 1)
+            qbytes = cells + 4 * rows          # int8 payload + f32 scales
+            counts = 4 * c * (2 + 2 * meta.num_cont
+                              if self.needs_moments else 1)
+            if shard.is_global:
+                self._collective_bytes = (
+                    4 * cells                          # ICI leg: exact psum
+                    + (qbytes if shard.quantized else 4 * cells)  # DCN leg
+                    + 2 * counts)
+            else:
+                gbytes = (qbytes if shard.quantized else 4 * cells)
+                self._collective_bytes = gbytes + counts
         # GraftFleet straggler attribution (round 15): a sampled
         # per-device wall probe around the fused dispatch, built lazily
         # on the first profiled fold — off (profile.on unset) the fold
@@ -669,6 +684,8 @@ class SharedScan:
         if self.shard is not None:
             attrs["shard.devices"] = self.shard.num_devices
             attrs["shard.axis"] = self.shard.data_axis
+            if self.shard.is_global:
+                attrs["shard.procs"] = self.shard.num_procs
         with tracer.span("scan", attrs=attrs) as scan_span:
             for ds in chunks:
                 # a pre-staged chunk (sharded prefetch) arrives ballast-
@@ -723,14 +740,18 @@ FUSABLE_JOBS = ("BayesianDistribution", "MutualInformation",
 _COMPAT_KEYS = ("feature.schema.file.path", "field.delim.regex",
                 "field.delim", "stream.chunk.rows", "stream.prefetch.depth",
                 "data.parallel.auto", "shard.devices", "shard.data.axis",
-                "shard.allreduce.quantized")
+                "shard.allreduce.quantized", "shard.proc.axis")
 
 
 def stage_fusable(job, conf) -> bool:
     """Can this (job name, stage conf) ride a SharedScan?  Conservative:
     anything the fused path does not reproduce byte-for-byte — per-stage
-    opt-out, text-mode NB, per-job stream checkpointing, multi-process
-    chunk ownership — keeps the stage on its own scan."""
+    opt-out, text-mode NB, per-job stream checkpointing — keeps the stage
+    on its own scan.  Multi-process runs fuse ONLY under an explicit
+    ``shard.*`` topology (CrossGraft: the global fold row-partitions each
+    chunk across processes inside the dispatch); without one, the per-job
+    round-robin chunk ownership + ``all_process_sum_state`` path remains
+    the multi-process contract."""
     if not isinstance(job, str) or job not in FUSABLE_JOBS:
         return False
     if not conf.get_bool("scan.fuse", True):
@@ -742,8 +763,10 @@ def stage_fusable(job, conf) -> bool:
     if not conf.get("feature.schema.file.path"):
         return False
     import jax
+
+    from avenir_tpu.parallel.shard import ShardSpec
     try:
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not ShardSpec.requested(conf):
             return False      # round-robin chunk ownership is per-job
     except Exception:                              # pragma: no cover
         return False
@@ -839,7 +862,11 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
     results = engine.run(data)
     rows = rows_fn()
     for name, _job, _inp, _out, _conf in stages:
-        writers[name](results[name])
+        # CrossGraft: under a global plan every process finalizes the
+        # SAME replicated totals — the single-writer output protocol
+        # (process 0 writes the part file, like the streaming jobs)
+        if Job.is_output_writer():
+            writers[name](results[name])
         counters[name].set("Records", "Processed", rows)
         counters[name].set("SharedScan", "FusedStages", len(stages))
         counters[name].set("SharedScan", "Scans", 1)

@@ -87,6 +87,14 @@ class StreamAnalytics(Job):
             # to an uninterrupted one
             ckpt.attach("drift", detector)
         delim = conf.field_delim
+        # CrossGraft: under a global shard plan every process folds the
+        # same windows to the same replicated totals — single-writer
+        # output protocol (process 0 writes; non-writers stream to
+        # devnull).  _window_lines still runs EVERYWHERE: it advances the
+        # drift detector, whose state rides each process's checkpoint
+        # snapshot — skipping it on non-writers would desynchronize the
+        # replicated detector state the elastic resume relies on
+        writer = self.is_output_writer()
 
         def handle(window):
             for ln in self._window_lines(window, detector, delim):
@@ -118,9 +126,9 @@ class StreamAnalytics(Job):
         # a completed stage, and never truncates a previous good artifact
         tmp_path = output_path.rstrip(os.sep) + ".inprogress"
         parent = os.path.dirname(tmp_path)
-        if parent:
+        if parent and writer:
             os.makedirs(parent, exist_ok=True)
-        out_fh = open(tmp_path, "w")
+        out_fh = open(tmp_path, "w") if writer else open(os.devnull, "w")
         step = max(min(queue.depth or pane_rows, pane_rows), 1)
         batch: List[str] = []
         try:
@@ -135,7 +143,8 @@ class StreamAnalytics(Job):
             ws.flush()
         finally:
             out_fh.close()
-        os.replace(tmp_path, output_target(output_path))
+        if writer:
+            os.replace(tmp_path, output_target(output_path))
         if ckpt is not None:
             ckpt.finish()                # clean completion: sweep snapshots
         counters.set("Records", "Processed", ws.rows_consumed)

@@ -442,11 +442,32 @@ class WindowCheckpointer:
     @classmethod
     def from_conf(cls, conf: JobConfig,
                   fault=None) -> Optional["WindowCheckpointer"]:
-        from avenir_tpu.jobs.base import StreamCheckpointer
+        from avenir_tpu.jobs.base import Job, StreamCheckpointer
 
         directory = conf.get("stream.checkpoint.dir")
         if not directory:
             return None
+        # CrossGraft: in a multi-process run every process snapshots its
+        # own (identical, replicated) ring under a process subdirectory —
+        # the StreamCheckpointer proc-scoping discipline — so two
+        # journal-writing processes never contend for one snapshot file.
+        # Like StreamCheckpointer, the subdirectory name PINS the process
+        # count: a conf-driven relaunch at a different nprocs finds no
+        # snapshot and restarts cleanly from zero; a deliberate
+        # kill-on-N → resume-on-M restore points stream.checkpoint.dir
+        # at the proc subdirectory itself (shard.reshard.on.restore then
+        # redistributes the process-qualified ring — the drill
+        # tests/test_multiprocess.py::test_crossgraft_* runs)
+        pid, nprocs = Job.process_grid()
+        if nprocs > 1:
+            if nprocs >= 10 ** 3:      # fixed-width name contract (GL003)
+                raise ConfigError(
+                    f"{nprocs} processes exceeds the proc-NNN-of-NNN "
+                    f"3-digit checkpoint-subdirectory width")
+            import os as _os
+
+            directory = _os.path.join(
+                directory, f"proc-{pid:03d}-of-{nprocs:03d}")
         return cls(
             directory,
             run_id=StreamCheckpointer.run_id_from_conf(conf),

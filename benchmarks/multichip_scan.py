@@ -27,8 +27,21 @@ interpreter, so those rates measure the harness, not the kernel —
 ``interpret_mode: true`` in the artifact flags them.  A fresh matmul
 canary rides each section per the PR-2 convention (a loaded rig indicts
 itself, not the scan).  One JSON object on stdout.
+
+CrossGraft (``--nprocs N``): the REAL multi-process capture — the
+harness drives itself through the fleet launcher
+(``avenir_tpu.launch.launch_local``): N OS processes ×
+``--devices-per-proc`` devices each join one jax-distributed fleet, the
+global (proc × data) SharedScan fold runs the hierarchical psum
+dispatch, byte-identity to each worker's local unsharded fold is
+asserted BEFORE any rate is recorded, and the artifact publishes
+aggregate + per-process rates, ``scaling_efficiency`` against the
+1-process local-mesh fold at the same per-process width, and the
+quantized cross-host hop's measured deviation — the first non-stub row
+of BASELINE.md's MULTICHIP table.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -78,7 +91,196 @@ def gen_data(n_rows, seed=29):
     return codes, cont, labels
 
 
+def _multiproc_worker(args):
+    """One fleet worker of the ``--nprocs`` capture: join via the env the
+    launcher wrote, fold the SAME chunk stream through the global mesh,
+    assert byte-identity to the local unsharded oracle, measure, and let
+    process 0 write the artifact JSON to ``--out``."""
+    from avenir_tpu.launch import join_from_env
+
+    idx = join_from_env()
+    import jax
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.encoding import EncodedDataset
+    from avenir_tpu.parallel.mesh import make_mesh
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.pipeline import scan
+    from avenir_tpu.utils.metrics import Counters
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
+
+    nprocs = jax.process_count()
+    d_local = len(jax.local_devices())
+    on_tpu = jax.local_devices()[0].platform == "tpu"
+    chunk = 262_144 if on_tpu else 2_048
+    n_chunks = 8 if on_tpu else 3
+    passes = 3 if on_tpu else 2
+    codes, cont, labels = gen_data(chunk * n_chunks)
+    ds = EncodedDataset(
+        codes=codes, cont=cont, labels=labels,
+        n_bins=np.full(N_FEAT, N_BINS, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(N_FEAT)),
+        cont_ordinals=list(range(N_FEAT, N_FEAT + N_CONT)))
+    n_rows = ds.num_rows
+
+    def chunks():
+        return iter([ds.slice(i, i + chunk) for i in range(0, n_rows, chunk)])
+
+    def engine(shard=None, counters=None):
+        eng = scan.SharedScan(shard=shard, counters=counters)
+        eng.register(scan.NaiveBayesConsumer(name="nb"))
+        eng.register(scan.MutualInfoConsumer(name="mi"))
+        return eng
+
+    def timed(shard=None):
+        counters = Counters()
+        eng = engine(shard, counters)
+        eng.run(chunks())                        # warm (compile + upload)
+        canary = matmul_canary_ms()
+        rates = []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            eng.run(chunks())
+            rates.append(n_rows / (time.perf_counter() - t0))
+        return float(np.median(rates)), canary, counters
+
+    base_results = engine().run(chunks())        # local 1-chip oracle
+
+    def identical(got):
+        np.testing.assert_array_equal(got["nb"].bin_counts,
+                                      base_results["nb"].bin_counts)
+        np.testing.assert_array_equal(got["mi"].pair_class_counts,
+                                      base_results["mi"].pair_class_counts)
+        if got["mi"].to_lines() != base_results["mi"].to_lines():
+            raise RuntimeError("global fold diverged from 1-chip oracle")
+
+    # 1-process local-mesh baseline at the same per-process width: the
+    # scaling-efficiency denominator (explicit spec — from_conf resolves
+    # globally in a multi-process runtime)
+    local_spec = ShardSpec(
+        mesh=make_mesh(("data",), shape=(d_local,),
+                       devices=jax.local_devices()))
+    identical(engine(local_spec).run(chunks()))
+    local_rate, local_canary, _ = timed(local_spec)
+
+    spec = ShardSpec.from_conf(JobConfig({"shard.devices": "all"}))
+    assert spec.is_global and spec.num_procs == nprocs
+    identical(engine(spec).run(chunks()))        # oracle gate before rates
+    rate, canary, counters = timed(spec)
+
+    qspec = ShardSpec.from_conf(JobConfig({
+        "shard.devices": "all", "shard.allreduce.quantized": "true"}))
+    q_res = engine(qspec).run(chunks())
+    try:
+        identical(q_res)
+        q_exact, q_dev = True, 0
+    except (AssertionError, RuntimeError):
+        q_exact = False
+        q_dev = int(np.abs(
+            np.asarray(q_res["nb"].bin_counts, np.int64)
+            - np.asarray(base_results["nb"].bin_counts, np.int64)).max())
+    q_rate, q_canary, _ = timed(qspec)
+
+    if idx == 0:
+        artifact = {
+            "benchmark": "multichip_scan",
+            "metric": "nb_mi_global_mesh_scan_throughput",
+            "mode": "multiprocess",
+            "topology": spec.announce(),
+            "interpret_mode": not on_tpu,
+            "rows_total": n_rows,
+            "chunk_rows": chunk,
+            "passes": passes,
+            "local_mesh_1proc": {
+                "devices": d_local,
+                "rows_per_sec_aggregate": round(local_rate, 1),
+                "canary_ms": round(local_canary, 2),
+            },
+            "global_mesh": {
+                "procs": nprocs,
+                "devices_total": spec.total_devices,
+                "rows_per_sec_aggregate": round(rate, 1),
+                "rows_per_sec_per_process": round(rate / nprocs, 1),
+                "scaling_efficiency": round(rate / (local_rate * nprocs), 3),
+                "collective_bytes_per_chunk": int(
+                    (counters.get("Shard", "collective.bytes") or 0)
+                    // max(1, counters.get("Shard", "chunks") or 1)),
+                "canary_ms": round(canary, 2),
+            },
+            "quantized_crosshost_hop": {
+                "rows_per_sec_aggregate": round(q_rate, 1),
+                "byte_identical_at_this_chunk_size": q_exact,
+                "max_bin_count_deviation": q_dev,
+                "canary_ms": round(q_canary, 2),
+            },
+            "canary_healthy_threshold_ms": 7.0,
+        }
+        # --out unset (launched by hand through the launcher CLI rather
+        # than the self-launching parent): keep the one-object-on-stdout
+        # contract — the launcher echoes rank 0's line
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(artifact, fh)
+        else:
+            print(json.dumps(artifact), flush=True)
+    print(f"proc {idx} multichip multiproc ok", flush=True)
+
+
+def _launch_multiproc(args):
+    """Parent side of ``--nprocs``: respawn this script as a fleet via
+    the launcher, then print process 0's artifact JSON on stdout (the
+    same one-object-on-stdout contract as the single-process mode)."""
+    import tempfile
+
+    from avenir_tpu.launch import LaunchError, launch_local
+
+    out = args.out or os.path.join(tempfile.mkdtemp(prefix="multichip_"),
+                                   "multichip_mp.json")
+    child = [os.path.abspath(__file__), "--nprocs", str(args.nprocs),
+             "--out", out]
+    result = launch_local(
+        child, args.nprocs, devices_per_proc=args.devices_per_proc,
+        join_timeout_s=120, timeout_s=3600, echo=False)
+    for w in result.workers:
+        sys.stderr.write(f"[p{w.rank}] exit={w.returncode}\n")
+    if result.exit_code:
+        failed = next(w for w in result.workers if w.returncode)
+        sys.stderr.write(failed.output[-3000:] + "\n")
+        raise LaunchError(
+            f"multichip worker p{failed.rank} exited "
+            f"{failed.returncode}")
+    with open(out) as fh:
+        print(fh.read())
+
+
 def main():
+    # resolve avenir_tpu from the repo root no matter how the script was
+    # invoked (the re-exec path passes PYTHONPATH; direct --nprocs runs
+    # need it here, and the launcher's workers inherit it)
+    _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _root not in sys.path:
+        sys.path.insert(0, _root)
+    os.environ["PYTHONPATH"] = (
+        _root + os.pathsep + os.environ.get("PYTHONPATH", "")).rstrip(
+        os.pathsep)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nprocs", type=int, default=0,
+                    help="CrossGraft capture: N launcher-driven worker "
+                         "processes (0 = single-process sections)")
+    ap.add_argument("--devices-per-proc", type=int, default=4)
+    ap.add_argument("--out", default=None,
+                    help="worker artifact path (parent default: tempfile)")
+    args = ap.parse_args()
+    if args.nprocs and os.environ.get("AVENIR_PROCESS_ID") is None:
+        _launch_multiproc(args)
+        return
+    if os.environ.get("AVENIR_PROCESS_ID") is not None:
+        _multiproc_worker(args)
+        return
+    _single_process_main()
+
+
+def _single_process_main():
     _maybe_force_host_mesh()
     import jax
 

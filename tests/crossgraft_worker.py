@@ -1,0 +1,231 @@
+"""Worker for the CrossGraft global-mesh SharedScan gate
+(tests/test_multiprocess.py::test_crossgraft_*).
+
+Each worker owns 4 virtual CPU devices; the hardened
+``init_distributed`` joins them into one 2-process × 4-device fleet
+(gloo CPU collectives — the cross-process transport the old
+multiprocess-env failures were missing).  The worker then drives the
+REAL CrossGraft data plane:
+
+- ``ShardSpec.from_conf`` resolves the ``shard.*`` family to the GLOBAL
+  (proc × data) hybrid mesh — the old single-process refusal is gone;
+- a batch SharedScan over a ragged multi-chunk stream folds every
+  consumer (NB, MI, correlation ×2, Fisher, moments) through the fused
+  hierarchical-psum dispatch and must equal the worker's own LOCAL
+  unsharded fold byte-for-byte (the 1-chip oracle, asserted in-process;
+  process 0 also saves the tables so the parent test re-asserts against
+  ITS single-chip fold in a fresh environment);
+- the EQuARX-style int8 cross-host hop (``shard.allreduce.quantized``)
+  must be exact at these per-device partial sizes;
+- a sliding-window ``WindowedScan`` (ragged tail pane included) inherits
+  the global fold through ``ChunkFolder`` and must recompile ZERO times
+  after ``warm()`` (CompileKeyMonitor-asserted);
+- a ``WindowCheckpointer`` snapshot is written mid-stream under the
+  process-qualified topology (``:mesh:proc2xdata4``) — the parent
+  resumes it on ONE process under ``shard.reshard.on.restore`` and
+  asserts byte-identical remaining windows (ElasticGraft composition);
+- every process journals its own shard: exactly one ``shard.topology``
+  event showing the process axis, and one ``fleet.join``.
+
+No module-level jax/avenir imports: the parent test imports
+:func:`gen_data`/:func:`expected_params` without touching the worker
+environment setup in :func:`main`.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+N, F, B, C, FC = 2200, 5, 6, 2, 3
+CHUNK = 700                      # ragged tail: 2200 % 700 = 100
+PANE_ROWS, WINDOW_PANES, SLIDE = 256, 3, 1
+CKPT_FEED = 1500                 # rows fed before the mid-stream snapshot
+CKPT_RUN_ID = "crossgraft-drill"
+
+
+def gen_data():
+    rng = np.random.default_rng(12)
+    codes = rng.integers(0, B, size=(N, F)).astype(np.int32)
+    # 1/16-grid continuous values: per-shard f32 partial sums exact, so
+    # the hierarchically-psum'd moments match the 1-chip fold bit-for-bit
+    cont = (rng.integers(0, 16, size=(N, FC)) / 16.0).astype(np.float32)
+    labels = rng.integers(0, C, size=N).astype(np.int32)
+    return codes, cont, labels
+
+
+def mk_ds(data):
+    from avenir_tpu.core.encoding import EncodedDataset
+
+    codes, cont, labels = data
+    return EncodedDataset(
+        codes=codes, cont=cont, labels=labels,
+        n_bins=np.full(F, B, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(F)),
+        cont_ordinals=list(range(F, F + FC)))
+
+
+def chunks_of(data):
+    ds = mk_ds(data)
+    return iter([ds.slice(i, min(i + CHUNK, N)) for i in range(0, N, CHUNK)])
+
+
+def build_engine(shard=None, counters=None):
+    from avenir_tpu.pipeline import scan
+
+    eng = scan.SharedScan(shard=shard, counters=counters)
+    eng.register(scan.NaiveBayesConsumer(name="nb"))
+    eng.register(scan.MutualInfoConsumer(name="mi"))
+    eng.register(scan.CorrelationConsumer(name="cramer", against_class=True))
+    eng.register(scan.CorrelationConsumer(name="het",
+                                          algorithm="uncertaintyCoeff"))
+    eng.register(scan.FisherConsumer(name="fisher"))
+    eng.register(scan.MomentsConsumer(name="moments"))
+    return eng
+
+
+def encoder_and_lines(data):
+    """Schema-complete encoder + the raw CSV lines encoding back to the
+    module data — the windowed-stream operand (same shape as
+    tests/test_shard.py's)."""
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+
+    codes, cont, labels = data
+    fields = [{"name": "id", "ordinal": 0, "id": True, "dataType": "string"}]
+    for j in range(F):
+        fields.append({"name": f"f{j}", "ordinal": 1 + j, "feature": True,
+                       "dataType": "categorical",
+                       "cardinality": [str(v) for v in range(B)]})
+    for j in range(FC):
+        fields.append({"name": f"x{j}", "ordinal": 1 + F + j,
+                       "feature": True, "dataType": "double"})
+    fields.append({"name": "cls", "ordinal": 1 + F + FC,
+                   "dataType": "categorical", "cardinality": ["a", "b"]})
+    enc = DatasetEncoder(FeatureSchema.from_json({"fields": fields}))
+    lines = [",".join([f"r{i}"] + [str(int(v)) for v in codes[i]]
+                      + [repr(float(x)) for x in cont[i]]
+                      + [["a", "b"][int(labels[i])]])
+             for i in range(len(labels))]
+    return enc, lines
+
+
+def stream_consumers():
+    from avenir_tpu.pipeline import scan
+
+    return [scan.NaiveBayesConsumer(name="nb"),
+            scan.MutualInfoConsumer(name="mi")]
+
+
+def results_npz(res):
+    """The byte-comparable arrays of one engine run, flat for np.savez."""
+    return {
+        "nb_bin": np.asarray(res["nb"].bin_counts),
+        "nb_class": np.asarray(res["nb"].class_counts),
+        "nb_sumsq": np.asarray(res["nb"].cont_sumsq),
+        "mi_pcc": np.asarray(res["mi"].pair_class_counts),
+        "mi_lines": np.array("\n".join(res["mi"].to_lines())),
+        "cramer_stat": np.asarray(res["cramer"].stat),
+        "het_stat": np.asarray(res["het"].stat),
+        "fisher_mean": np.asarray(res["fisher"].mean),
+        "fisher_var": np.asarray(res["fisher"].var),
+        "mom_cnt": np.asarray(res["moments"][0]),
+        "mom_s2": np.asarray(res["moments"][2]),
+    }
+
+
+def main():
+    port, pid, nprocs, outdir = sys.argv[1:5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "").replace(
+            "--xla_force_host_platform_device_count=8", "").strip() +
+        " --xla_force_host_platform_device_count=4").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from avenir_tpu.parallel.mesh import init_distributed
+
+    idx = init_distributed(coordinator_address=f"localhost:{port}",
+                           num_processes=int(nprocs), process_id=int(pid),
+                           timeout_s=120, attempts=3)
+    assert idx == int(pid) and jax.process_count() == int(nprocs)
+    assert len(jax.local_devices()) == 4
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.stream.windows import WindowCheckpointer, WindowedScan
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.utils.metrics import Counters
+
+    # every process journals its own shard of one run (GraftFleet)
+    tel.configure(JobConfig({"trace.on": "true",
+                             "trace.journal.dir": os.path.join(outdir, "tel"),
+                             "trace.run.id": "xg"}))
+
+    spec = ShardSpec.from_conf(JobConfig({"shard.devices": "4"}))
+    assert spec.is_global and spec.num_procs == int(nprocs)
+    assert spec.g_suffix == f":mesh:proc{nprocs}xdata4"
+    spec.announce()
+
+    data = gen_data()
+    # 1-chip oracle: the worker's own LOCAL unsharded fold
+    base = build_engine().run(chunks_of(data))
+    counters = Counters()
+    out = build_engine(spec, counters).run(chunks_of(data))
+    for key, want in results_npz(base).items():
+        got = results_npz(out)[key]
+        np.testing.assert_array_equal(got, want, err_msg=key)
+    assert counters.get("Shard", "chunks") == 4
+    assert counters.get("Shard", "collective.bytes") > 0
+
+    # EQuARX int8 cross-host hop: exact at these per-device partials
+    qspec = ShardSpec.from_conf(JobConfig({
+        "shard.devices": "4", "shard.allreduce.quantized": "true"}))
+    qout = build_engine(qspec).run(chunks_of(data))
+    np.testing.assert_array_equal(np.asarray(qout["nb"].bin_counts),
+                                  np.asarray(base["nb"].bin_counts))
+    np.testing.assert_array_equal(np.asarray(qout["mi"].pair_class_counts),
+                                  np.asarray(base["mi"].pair_class_counts))
+
+    # sliding-window stream: inherits the global fold through ChunkFolder;
+    # ragged tail pane; zero steady-state recompiles after warm()
+    enc, lines = encoder_and_lines(data)
+    ws = WindowedScan(enc, stream_consumers(), PANE_ROWS,
+                      window_panes=WINDOW_PANES, slide_panes=SLIDE,
+                      shard=spec)
+    ws.warm()
+    windows = ws.feed(lines)
+    windows.extend(ws.flush())
+    assert windows, "stream emitted no windows"
+    assert (ws.counters.get("Stream", "recompiles") or 0) == 0, \
+        "steady-state stream recompiled under the global plan"
+
+    # mid-stream snapshot under the process-qualified topology — the
+    # parent resumes it on ONE process under shard.reshard.on.restore
+    ck_dir = os.path.join(outdir, f"ckpt-proc{idx}")
+    ckpt = WindowCheckpointer(ck_dir, run_id=CKPT_RUN_ID, interval_panes=2)
+    ws2 = WindowedScan(enc, stream_consumers(), PANE_ROWS,
+                       window_panes=WINDOW_PANES, slide_panes=SLIDE,
+                       shard=spec, checkpointer=ckpt)
+    # no warm(): ws already compiled every pane bucket (memoized step)
+    ws2.feed(lines[:CKPT_FEED])
+    ckpt.save(ws2)                       # durable ring at the current pane
+    # deliberately NO finish(): the snapshot must survive (kill shape)
+
+    if idx == 0:
+        saved = results_npz(out)
+        saved.update({"win_nb_bin": np.stack(
+            [np.asarray(w.results["nb"].bin_counts) for w in windows]),
+            "win_mi_lines": np.array(
+                ["\n".join(w.results["mi"].to_lines())
+                 for w in windows]),
+            "win_rows": np.array([w.rows for w in windows])})
+        np.savez(os.path.join(outdir, "crossgraft.npz"), **saved)
+    tel.tracer().disable()
+    print(f"proc {idx} crossgraft ok windows={len(windows)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

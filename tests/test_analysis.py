@@ -392,6 +392,12 @@ def test_whole_tree_zero_nonbaselined_findings():
     # undocumented tenant.*/fault.tenant.* key (GL004) or a sync-in-loop
     # around the DRR harness (GL005) would hide (avenir_tpu/tenancy/ and
     # benchmarks/tenancy_soak.py sit inside trees the gate already walks)
+    # tests/crossgraft_worker.py + test_multiprocess.py likewise (this
+    # round) — the CrossGraft global-mesh gate drives the multi-process
+    # fold + launcher + elastic restore, where an undocumented shard.*
+    # key (GL004), an unguarded writer near the join collective (GL001),
+    # or a sync-in-loop around the fused dispatch (GL005) would hide
+    # (avenir_tpu/launch/ itself sits inside the avenir_tpu tree)
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
@@ -406,7 +412,9 @@ def test_whole_tree_zero_nonbaselined_findings():
          str(REPO / "tests" / "test_reshard.py"),
          str(REPO / "tests" / "reshard_worker.py"),
          str(REPO / "tests" / "test_pool.py"),
-         str(REPO / "tests" / "test_tenancy.py")],
+         str(REPO / "tests" / "test_tenancy.py"),
+         str(REPO / "tests" / "crossgraft_worker.py"),
+         str(REPO / "tests" / "test_multiprocess.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

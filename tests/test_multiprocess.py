@@ -28,6 +28,19 @@ def _free_port() -> int:
     return port
 
 
+def _worker_env(devices: int = 4):
+    """Env for a fresh multi-process worker: forced CPU platform and a
+    clean per-worker virtual device count (the parent's 8-device
+    conftest flags must not leak)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return env, repo_root
+
+
 def _cli_job_specs(tmp_path):
     """Per-job (dataset, conf) specs for the multi-process CLI contract —
     ALL count-shaped jobs the reference executed across N machines (round-4
@@ -253,6 +266,233 @@ def test_multi_process_job_cli_byte_identical(tmp_path):
         a = (tmp_path / sp / "part-00000").read_bytes()
         b = (tmp_path / mp / "part-00000").read_bytes()
         assert a == b, f"{mp} differs from single-process output"
+
+
+# ---------------------------------------------------------------------------
+# CrossGraft (this round): the global-mesh SharedScan + fleet launcher
+# ---------------------------------------------------------------------------
+
+def test_crossgraft_global_sharedscan_byte_identity(tmp_path):
+    """THE CrossGraft acceptance gate: a 2-process × 4-virtual-device
+    global-mesh SharedScan — batch (every consumer: NB, MI, correlation,
+    Fisher/moments; ragged tails) AND a sliding-window stream — executed
+    by REAL OS processes joined through the hardened coordinator join,
+    byte-identical to the single-chip fold computed HERE, with zero
+    steady-state recompiles (asserted in-worker) and one
+    ``shard.topology`` event per journal shard showing the process axis.
+    Also covers ElasticGraft composition: the worker's mid-stream
+    snapshot (written under ``:mesh:proc2xdata4``) resumes on ONE
+    process under ``shard.reshard.on.restore`` with byte-identical
+    remaining windows."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import crossgraft_worker as xw
+
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "crossgraft_worker.py")
+    env, repo_root = _worker_env(devices=4)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid), "2",
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo_root)
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    joined = "".join(outs)
+    for pid in range(2):
+        assert f"proc {pid} crossgraft ok" in joined
+
+    # single-chip oracle computed in THIS process (the conftest 8-device
+    # env; the unsharded fold is device-count-independent)
+    data = xw.gen_data()
+    base = xw.build_engine().run(xw.chunks_of(data))
+    want = xw.results_npz(base)
+    got = np.load(tmp_path / "crossgraft.npz")
+    for key, arr in want.items():
+        np.testing.assert_array_equal(got[key], arr, err_msg=key)
+
+    # windowed-stream byte-identity vs an unsharded WindowedScan here
+    enc, lines = xw.encoder_and_lines(data)
+    from avenir_tpu.stream.windows import WindowedScan
+
+    ws = WindowedScan(enc, xw.stream_consumers(), xw.PANE_ROWS,
+                      window_panes=xw.WINDOW_PANES, slide_panes=xw.SLIDE)
+    plain = ws.feed(lines)
+    plain.extend(ws.flush())
+    assert len(plain) == got["win_nb_bin"].shape[0]
+    for k, w in enumerate(plain):
+        np.testing.assert_array_equal(got["win_nb_bin"][k],
+                                      np.asarray(w.results["nb"].bin_counts))
+        assert str(got["win_mi_lines"][k]) == \
+            "\n".join(w.results["mi"].to_lines())
+        assert int(got["win_rows"][k]) == w.rows
+
+    # one shard.topology per journal shard, process axis visible; one
+    # fleet.join per shard naming the coordinator
+    from avenir_tpu.telemetry.journal import find_shards, read_events
+
+    shards = find_shards(str(tmp_path / "tel"), run_id="xg").get("xg", [])
+    assert len(shards) == 2, shards
+    for shard_path in shards:
+        events = read_events(shard_path)
+        topo = [e for e in events if e["ev"] == "shard.topology"]
+        assert len(topo) == 1
+        assert topo[0]["axes"] == ["proc", "data"]
+        assert topo[0]["mesh"] == {"proc": 2, "data": 4}
+        assert topo[0]["devices"] == 8 and topo[0]["procs"] == 2
+        joins = [e for e in events if e["ev"] == "fleet.join"]
+        assert len(joins) == 1
+        assert joins[0]["coordinator"].endswith(str(port))
+        assert joins[0]["nprocs"] == 2
+
+    # ElasticGraft composition: kill-on-2-procs → resume-on-1-proc.
+    # The worker's snapshot ring was folded under :mesh:proc2xdata4;
+    # restoring it into an UNSHARDED WindowedScan must refuse without
+    # the gate, redistribute exactly with it.
+    from avenir_tpu.core.config import ConfigError
+    from avenir_tpu.stream.windows import WindowCheckpointer
+
+    ck_dir = str(tmp_path / "ckpt-proc0")
+    with pytest.raises(ConfigError, match="shard.reshard.on.restore"):
+        ws_refuse = WindowedScan(enc, xw.stream_consumers(), xw.PANE_ROWS,
+                                 window_panes=xw.WINDOW_PANES,
+                                 slide_panes=xw.SLIDE)
+        WindowCheckpointer(ck_dir, run_id=xw.CKPT_RUN_ID,
+                           resume=True).restore_into(ws_refuse)
+    ws_resume = WindowedScan(enc, xw.stream_consumers(), xw.PANE_ROWS,
+                             window_panes=xw.WINDOW_PANES,
+                             slide_panes=xw.SLIDE)
+    ck = WindowCheckpointer(ck_dir, run_id=xw.CKPT_RUN_ID, resume=True,
+                            reshard=True)
+    skip = ck.restore_into(ws_resume)
+    assert 0 < skip < len(lines)
+    resumed = ws_resume.feed(lines[skip:])
+    resumed.extend(ws_resume.flush())
+    tail = plain[len(plain) - len(resumed):]
+    assert len(resumed) == len(tail) > 0
+    for a, b in zip(resumed, tail):
+        np.testing.assert_array_equal(np.asarray(a.results["nb"].bin_counts),
+                                      np.asarray(b.results["nb"].bin_counts))
+        assert a.results["mi"].to_lines() == b.results["mi"].to_lines()
+
+
+def test_fleet_launcher_job_cli_byte_identical(tmp_path):
+    """The fleet launcher end-to-end: ``python -m avenir_tpu.launch
+    --nprocs 2 -- BayesianDistribution …`` spawns 2 workers × 2 virtual
+    devices, wires the coordinator join, assigns per-process
+    ``trace.writer.suffix`` shards, merges the journals on teardown, and
+    the multi-process output is byte-identical to a single-process run."""
+    import json
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.datagen.hosp_readmit import (HOSP_SCHEMA_JSON,
+                                                 generate_hosp_readmit)
+    from avenir_tpu.jobs import get_job
+
+    rows = generate_hosp_readmit(2000, seed=5)
+    (tmp_path / "train.csv").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    (tmp_path / "schema.json").write_text(
+        json.dumps(HOSP_SCHEMA_JSON) if isinstance(HOSP_SCHEMA_JSON, dict)
+        else HOSP_SCHEMA_JSON)
+
+    base = {"feature.schema.file.path": str(tmp_path / "schema.json"),
+            "stream.chunk.rows": "250"}
+    conf = JobConfig()
+    for k, v in base.items():
+        conf.set(k, v)
+    conf.set("data.parallel.auto", "false")
+    get_job("BayesianDistribution").run(conf, str(tmp_path / "train.csv"),
+                                        str(tmp_path / "out_sp"))
+
+    env, repo_root = _worker_env(devices=2)
+    tel_dir = tmp_path / "tel"
+    argv = [sys.executable, "-m", "avenir_tpu.launch",
+            "--nprocs", "2", "--devices-per-proc", "2",
+            "--join-timeout-sec", "120",
+            "--journal-dir", str(tel_dir), "--",
+            "BayesianDistribution",
+            f"-Dfeature.schema.file.path={tmp_path / 'schema.json'}",
+            "-Dstream.chunk.rows=250",
+            "-Dtrace.on=true",
+            f"-Dtrace.journal.dir={tel_dir}",
+            "-Dtrace.run.id=fleetnb",
+            str(tmp_path / "train.csv"), str(tmp_path / "out_mp")]
+    res = subprocess.run(argv, env=env, cwd=repo_root, capture_output=True,
+                         text=True, timeout=600)
+    assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-3000:]
+    a = (tmp_path / "out_sp" / "part-00000").read_bytes()
+    b = (tmp_path / "out_mp" / "part-00000").read_bytes()
+    assert a == b, "launcher-driven 2-process NB differs from single-process"
+    # per-process writer-suffix shards + one merged fleet view
+    names = sorted(p.name for p in tel_dir.glob("run-fleetnb.*.jsonl"))
+    assert names == ["run-fleetnb.proc-0-w0.jsonl",
+                     "run-fleetnb.proc-1-w1.jsonl"], names
+    assert "merged fleet journal" in res.stderr
+    merged = tel_dir / "fleet-fleetnb.jsonl"
+    assert merged.exists()
+    from avenir_tpu.telemetry.journal import read_events
+
+    events = read_events(str(merged))
+    assert {e.get("proc") for e in events} == {0, 1}
+    joins = [e for e in events if e["ev"] == "fleet.join"]
+    # both workers record their join; the job seam replays it at most
+    # once per journal (the NB job itself runs unsharded here, so the
+    # replay seam may not fire — teardown-merge tolerates 0..1 per shard)
+    assert len(joins) <= 2
+
+
+def test_fleet_launcher_propagates_first_nonzero_exit(tmp_path):
+    """A worker argv that fails must surface through the launcher as a
+    non-zero exit (first failure in completion order), not a hang."""
+    env, repo_root = _worker_env(devices=1)
+    argv = [sys.executable, "-m", "avenir_tpu.launch",
+            "--nprocs", "2", "--devices-per-proc", "1",
+            "--join-timeout-sec", "60", "--timeout-sec", "300", "--",
+            "NoSuchJobAnywhere", str(tmp_path / "in.csv"),
+            str(tmp_path / "out")]
+    res = subprocess.run(argv, env=env, cwd=repo_root, capture_output=True,
+                         text=True, timeout=420)
+    assert res.returncode not in (0, None), res.stdout[-2000:]
+
+
+def test_hardened_join_times_out_typed(tmp_path):
+    """A bad coordinator address must raise the typed LaunchError naming
+    the address within the bounded timeout — never hang the worker (the
+    pre-CrossGraft failure mode)."""
+    env, repo_root = _worker_env(devices=1)
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from avenir_tpu.parallel.mesh import init_distributed\n"
+        "from avenir_tpu.launch import LaunchError\n"
+        "try:\n"
+        "    init_distributed(coordinator_address='localhost:9',\n"
+        "                     num_processes=2, process_id=1,\n"
+        "                     timeout_s=3, attempts=2)\n"
+        "except LaunchError as e:\n"
+        "    assert 'localhost:9' in str(e), str(e)\n"
+        "    print('typed join timeout ok')\n"
+    )
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=repo_root, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "typed join timeout ok" in res.stdout
 
 
 @pytest.mark.parametrize("nprocs", [2, 4])

@@ -9,6 +9,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 from avenir_tpu.ops import pallas_knn as pk
 
+# condition-gated environment skip (CrossGraft triage of the long-standing
+# tier-1 failures): these tests NEED pltpu.force_tpu_interpret_mode — the
+# Mosaic-TPU interpreter entry added in jax 0.4.38 — and this container's
+# jax (0.4.37) predates it; the fused kNN kernel has no other CPU
+# interpreter path.  The skip self-heals: on a rig whose jax ships the
+# API the whole module runs again, unchanged.
+needs_tpu_interpret = pytest.mark.skipif(
+    not hasattr(pltpu, "force_tpu_interpret_mode"),
+    reason="jax.experimental.pallas.tpu.force_tpu_interpret_mode absent "
+           "in this jax build (needs >= 0.4.38); the Mosaic kNN kernel "
+           "cannot run off-TPU without it — environment-bound, "
+           "auto-re-enabled on a jax that ships the API")
+
 
 def _oracle(codes_q, cont_q, codes_r, cont_r, k):
     mism = (codes_q[:, None, :] != codes_r[None, :, :]).sum(-1).astype(np.float64)
@@ -21,6 +34,7 @@ def _oracle(codes_q, cont_q, codes_r, cont_r, k):
 
 
 @pytest.mark.parametrize("f,fc", [(6, 8), (4, 0), (0, 5)])
+@needs_tpu_interpret
 def test_pallas_topk_exact(rng, f, fc):
     nb, k = 7, 5
     n, m = 3000, 40
@@ -44,6 +58,7 @@ def test_pallas_topk_exact(rng, f, fc):
         np.testing.assert_allclose(d, od, atol=1e-6)
 
 
+@needs_tpu_interpret
 def test_tiny_reference_set_pads_masked(rng):
     # k <= n < k+MARGIN: pad rows land in candidate slots; their indices
     # must be masked, not index codes_r out of bounds, and the certificate
@@ -81,6 +96,7 @@ def test_certificate_flags_close_calls():
 
 
 @pytest.mark.parametrize("f,fc", [(6, 8), (4, 0), (0, 5)])
+@needs_tpu_interpret
 def test_search_fused_matches_oracle_and_host_path(rng, f, fc):
     # the PRODUCTION path (models/knn.py): one jitted dispatch running
     # device-side query pack -> kernel -> device-side exact re-rank; its
@@ -114,6 +130,7 @@ def test_search_fused_matches_oracle_and_host_path(rng, f, fc):
         np.testing.assert_array_equal(i, hi)
 
 
+@needs_tpu_interpret
 def test_search_fused_tiny_reference_set(rng):
     import jax.numpy as jnp
 
@@ -135,6 +152,7 @@ def test_search_fused_tiny_reference_set(rng):
     np.testing.assert_allclose(d[:, :n], od[:, :n], atol=2e-5)
 
 
+@needs_tpu_interpret
 def test_search_fused_block2_path_matches_oracle(rng):
     # enough reference blocks to engage the block top-2 sweep
     # (2*nblocks >= k+margin) — the production path at scale; verify exact
@@ -164,6 +182,7 @@ def test_search_fused_block2_path_matches_oracle(rng):
     assert (i[ok] == oi[ok]).mean() == 1.0
 
 
+@needs_tpu_interpret
 def test_search_fused_block2_short_last_block_not_falsely_certified(rng):
     # regression: n_real = 8*TN+1 puts one real ref in the last block, so a
     # pad lands in the candidate pool; that must NOT certify rows (the
@@ -195,6 +214,7 @@ def test_search_fused_block2_short_last_block_not_falsely_certified(rng):
     assert (~cert).any()
 
 
+@needs_tpu_interpret
 def test_search_fused_block2_heavy_ties_and_duplicates(rng):
     # adversarial for the block top-2 sweep: many duplicated reference rows
     # (ties across and within blocks) — certified rows must still be exact

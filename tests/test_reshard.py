@@ -196,6 +196,43 @@ def test_adopt_state_rekeys_across_mesh_sizes(data):
     np.testing.assert_array_equal(t4.class_counts, t1.class_counts)
 
 
+def test_adopt_state_rekeys_cross_process_topology(data):
+    """CrossGraft composition: a snapshot folded under the GLOBAL
+    process-qualified topology (``:mesh:proc2xdata4`` — the 2-process ×
+    4-device fold; its 64-bit host totals are byte-identical to any
+    other topology's by the psum argument, so constructing the state by
+    re-keying an 8-device fold IS the 2-proc state, and the real-OS-
+    process leg is proven in tests/test_multiprocess.py) redistributes
+    onto a 1-process mesh exactly — kill on 2 procs, resume on 1."""
+    f8, state8 = _fold_state(data, spec_for(8))
+    proc_sfx = ":mesh:proc2xdata4"
+    assert reshard.suffix_procs(proc_sfx) == 2
+    assert reshard.suffix_procs(":mesh:data8") == 1
+    state2p, moved = reshard.rekey_state(state8, proc_sfx)
+    assert moved == [f8.gk]
+    assert reshard.state_suffix(state2p) == proc_sfx
+
+    # resume-on-1-proc: adopt onto the 4-device single-process folder
+    f4, _ = _fold_state(data, spec_for(4))
+    adopted, moved2 = f4.adopt_state(state2p)
+    assert moved2 == [reshard.split_mesh_key(f8.gk)[0] + proc_sfx]
+    acc = agg.Accumulator()
+    acc.load(adopted)
+    t4 = f4.tables(acc, N)
+    base_acc = agg.Accumulator()
+    base_acc.load(state8)
+    t8 = f8.tables(base_acc, N)
+    np.testing.assert_array_equal(t4.fbc, t8.fbc)
+    np.testing.assert_array_equal(t4.pcc, t8.pcc)
+    np.testing.assert_array_equal(t4.class_counts, t8.class_counts)
+    # the whole-snapshot walker re-keys process-qualified rings too
+    tree = {"shard": proc_sfx,
+            "ring": [{"pane": 0, "rows": 5, "state": dict(state2p)}]}
+    out, moved3 = reshard.reshard_state_tree(tree, spec_for(4))
+    assert len(moved3) == 1 and out["shard"] == ":mesh:data4"
+    assert any(k.endswith(":mesh:data4") for k in out["ring"][0]["state"])
+
+
 def test_adopt_state_demotes_gram_onto_einsum_routing(data):
     """Sharded gram state restored onto the chunked-einsum routing (the
     1-chip CPU path) is DEMOTED through counts_from_cooc — the identical

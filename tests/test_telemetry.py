@@ -276,9 +276,15 @@ GOLDEN_EVENT_KEYS = {
                    "family", "warmed"},
     # ShardGraft (round 12): the run's hardware identity — journaled at
     # run start so every bench/journal artifact self-describes what it
-    # ran on (device kind, mesh shape, axis names)
+    # ran on (device kind, mesh shape, axis names; CrossGraft added the
+    # process count — a global mesh's axes carry the proc axis too)
     "shard.topology": {"ev", "ts", "trace", "span", "devices",
-                       "device_kind", "mesh", "axes"},
+                       "device_kind", "mesh", "axes", "procs"},
+    # CrossGraft (this round): one coordinator-join record per worker —
+    # the hardened bounded join (parallel/mesh.py::journal_fleet_join);
+    # proc/host identity rides the GraftFleet stamp
+    "fleet.join": {"ev", "ts", "trace", "span", "coordinator", "nprocs",
+                   "attempts", "wall_ms"},
     # GraftProf (round 14): the compiled-program registry (one event per
     # distinct (site, compile key) with AOT cost fields — null when the
     # backend degrades to shapes-only), the cumulative per-program wall
@@ -377,7 +383,14 @@ def test_golden_event_shapes(tmp_path):
         tracer.event("model.swap", model="naiveBayes", version=2,
                      family="naiveBayes", warmed=True)
         tracer.event("shard.topology", devices=8, device_kind="cpu",
-                     mesh={"data": 8}, axes=["data"])
+                     mesh={"proc": 2, "data": 4}, axes=["proc", "data"],
+                     procs=2)
+        # fleet.join rides its REAL emission path (the hardened join's
+        # journal helper — event_once keyed on the coordinator)
+        from avenir_tpu.parallel.mesh import journal_fleet_join
+
+        journal_fleet_join("localhost:12345", nprocs=2, attempts=1,
+                           wall_ms=42.5)
         # GraftFleet events (round 15): the skew probe's publish path is
         # the REAL emission seam (parallel/skew.py — fed fabricated
         # per-device times, exactly what the fault-injection knob does);
