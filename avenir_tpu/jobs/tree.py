@@ -55,6 +55,10 @@ def _tree_params(conf: JobConfig) -> dict:
         selection=conf.get("split.selection.path", "device"),
         split_search=conf.get("split.search", "exhaustive"),
         hist_mode=conf.get("tree.hist.mode", "direct"),
+        # tree.level.packed auto|on|off — PackGraft per-level sibling
+        # packing (one wide disjoint gram per frontier); auto packs only
+        # where the joint shape rides the TPU kernel
+        level_packed=conf.get("tree.level.packed", "auto"),
     )
 
 
@@ -248,7 +252,7 @@ class DecisionTreeBuilder(Job):
             seed=conf.get_int("seed", 0),
             mesh=self.auto_mesh(conf),
             selection=p["selection"], split_search=p["split_search"],
-            hist_mode=p["hist_mode"],
+            hist_mode=p["hist_mode"], level_packed=p["level_packed"],
             collect_phase_stats=conf.get_bool("tree.hist.phase.stats", False),
         )
         model = trainer.fit(ds, is_cat)

@@ -279,6 +279,41 @@ def _level_table_cross(codes_t: jax.Array, node_ids: jax.Array,
     return t.reshape(t.shape[0], t.shape[1], num_nodes, c)
 
 
+@functools.partial(jax.jit, static_argnames=("pplan", "kernel", "interpret"))
+def _level_table_packed(codes_t: jax.Array, node_ids: jax.Array,
+                        labels: jax.Array, pplan, kernel: bool,
+                        interpret: bool = False) -> jax.Array:
+    """The level table via a PackGraft disjoint pack: the K frontier
+    nodes' [F, B, C] tables ride ONE wide gram over K bin stripes
+    (composite code = code + node·stripe_bins, ``pallas_hist.pack_disjoint``)
+    so sibling tables the subtraction plan still contracts one-by-one
+    inherit the wide-gram width tier.  The readout is the pack's diagonal
+    gather — exact: rows off the frontier (node −1) drop whole and
+    out-of-range codes drop per-feature, the same validity
+    ``node_bin_class_counts`` masks, and cross-member cells are
+    structurally zero (one node per row).  ``kernel`` routes the joint
+    shape onto the int8 MXU kernel; off it the exact einsum gram runs
+    the same layout.  Returns [F, B, K, C]."""
+    from avenir_tpu.ops import pallas_hist
+
+    c = pplan.num_classes
+    comp = pallas_hist.packed_codes.__wrapped__(
+        codes_t, node_ids, pplan.stripe_bins, pplan.members[0].num_bins)
+    if kernel:
+        g = pallas_hist.cooc_counts_cols.__wrapped__(
+            comp, labels, pplan.num_bins, c, interpret=interpret)
+    else:
+        g = pallas_hist.gram_counts_cols.__wrapped__(
+            comp, labels, pplan.num_bins, c)
+    wi = jnp.asarray(pallas_hist.packed_diag_index(pplan))   # [F, B, K, C]
+    if g.ndim == 3:                          # cls/clsb: per-class diagonal
+        w2 = wi[..., 0]                      # [F, B, K] — same cell per class
+        t = jnp.moveaxis(g[:, w2, w2], 0, -1)
+    else:                                    # fmaj/jmaj: class rides the cell
+        t = g[wi, wi]
+    return t.astype(jnp.int32)
+
+
 @jax.jit
 def _remap_nodes(node: jax.Array, remap: jax.Array) -> jax.Array:
     """[N] absolute node ids → frontier-local indices (−1 = settled)."""
@@ -901,6 +936,7 @@ class DecisionTree:
         selection: str = "device",
         split_search: str = "exhaustive",
         hist_mode: str = "direct",
+        level_packed: str = "auto",
         collect_phase_stats: bool = False,
     ):
         if algorithm not in ALGORITHMS:
@@ -914,9 +950,17 @@ class DecisionTree:
         if hist_mode not in HIST_MODES:
             raise ValueError(f"unknown hist_mode {hist_mode!r}; "
                              f"known: {HIST_MODES}")
+        if level_packed not in ("auto", "on", "off"):
+            raise ValueError(f"unknown level_packed {level_packed!r}; "
+                             "known: auto, on, off")
         self.selection = selection
         self.split_search = split_search
         self.hist_mode = hist_mode
+        # PackGraft (round 16): "auto" packs frontier sibling tables into
+        # one wide disjoint gram when the joint shape rides the TPU
+        # kernel; "on" forces packing (einsum gram off-TPU — the testable
+        # attestation path); "off" keeps cross/einsum routing only
+        self.level_packed = level_packed
         # per-level phase breakdown (table-build / score+select /
         # partition wall ms) — opt-in because honest phase timings need
         # a device sync per phase; read ``self.level_stats`` after fit
@@ -969,7 +1013,16 @@ class DecisionTree:
         use_cross = (self.mesh is None and pallas_hist.on_tpu_single_device()
                      and pallas_hist.cross_applicable(
                          ds.num_binned, ds.max_bins, max(c, 1)))
-        codes_t_dev = codes_dev.T if use_cross else None
+        # PackGraft: may the level fold sibling node tables as one wide
+        # disjoint gram?  auto = only where the joint shape would ride the
+        # TPU kernel (the width-tier climb is the whole point); "on"
+        # forces it (exact einsum gram off-TPU).  The decision per level
+        # still goes through pack_disjoint's shape gates in build_table.
+        may_pack = self.mesh is None and (
+            self.level_packed == "on"
+            or (self.level_packed == "auto"
+                and pallas_hist.on_tpu_single_device()))
+        codes_t_dev = codes_dev.T if (use_cross or may_pack) else None
         all_splits = candidate_splits_for(
             ds, self.split_search, self.max_split, is_categorical,
             self.max_candidates_per_attr)
@@ -1005,16 +1058,28 @@ class DecisionTree:
         def build_table(local_ids, k_slots):
             """The ONE level contraction entry (shared by the full-frontier
             and direct-slot builds): cross-gram kernel when the selector
-            width qualifies, einsum otherwise.  Returns (table, on_kernel)."""
+            width qualifies, the PackGraft disjoint pack where the pack
+            planner accepts the frontier, einsum otherwise.  Returns
+            (table, path) with path in ("cross", "packed", "einsum")."""
             cross = use_cross and pallas_hist.cross_applicable(
                 ds.num_binned, ds.max_bins, k_slots * c)
             if cross:
                 return _level_table_cross(
                     codes_t_dev, local_ids, labels_dev, k_slots, c,
-                    ds.max_bins), True
+                    ds.max_bins), "cross"
+            if may_pack and k_slots > 0:
+                pplan = pallas_hist.pack_disjoint(
+                    k_slots, ds.num_binned, ds.max_bins, max(c, 1))
+                if pplan is not None:
+                    kernel = (pallas_hist.packed_applicable(pplan)
+                              and pallas_hist.on_tpu_single_device())
+                    if kernel or self.level_packed == "on":
+                        return _level_table_packed(
+                            codes_t_dev, local_ids, labels_dev, pplan,
+                            kernel), "packed"
             return node_bin_class_counts(
                 codes_dev, local_ids, labels_dev, k_slots, c,
-                ds.max_bins), False
+                ds.max_bins), "einsum"
 
         for depth in range(self.max_depth):
             if not frontier:
@@ -1038,13 +1103,13 @@ class DecisionTree:
                 k_contracted = kd
                 local_direct = _remap_nodes(node_dev,
                                             jnp.asarray(remap_direct))
-                direct_dev, cross_lv = build_table(local_direct, kd)
+                direct_dev, path_lv = build_table(local_direct, kd)
                 table_dev = _assemble_subtract_table(
                     direct_dev, prev_table_dev, jnp.asarray(dslot),
                     jnp.asarray(pslot), jnp.asarray(sib_mat))
             else:
                 local_node_dev = _remap_nodes(node_dev, remap_dev)
-                table_dev, cross_lv = build_table(local_node_dev, k)
+                table_dev, path_lv = build_table(local_node_dev, k)
             if use_subtract:
                 # only the subtract path ever reads the previous level's
                 # table; retaining it otherwise would hold a second dead
@@ -1171,13 +1236,18 @@ class DecisionTree:
                 self.level_stats.append({
                     "level": depth, "frontier": k,
                     "contracted_slots": k_contracted,
+                    "path": path_lv,
                     # the contraction's true dot width ON THE PATH THIS
-                    # LEVEL TOOK: the kernel pads the selector to
-                    # 128-lane tiles, so halved slots only halve the dot
-                    # once K·C crosses a lane boundary (einsum fallback
-                    # scales with K·C directly)
-                    "sel_width": (pallas_hist.cross_sel_width(
-                        k_contracted * c) if cross_lv else
+                    # LEVEL TOOK: the cross kernel pads the selector to
+                    # 128-lane tiles, a packed level pays the joint pack
+                    # width (pack_disjoint is pure — same plan it built),
+                    # the einsum fallback scales with K·C directly
+                    "sel_width": (
+                        pallas_hist.cross_sel_width(k_contracted * c)
+                        if path_lv == "cross" else
+                        pallas_hist.pack_disjoint(
+                            k_contracted, ds.num_binned, ds.max_bins,
+                            max(c, 1)).wp if path_lv == "packed" else
                         k_contracted * c),
                     "table_ms": round((t_tab - t_lv) * 1e3, 3),
                     "select_ms": round((t_sel - t_tab) * 1e3, 3),

@@ -78,6 +78,7 @@ out-of-range labels invalidate the whole row — bit-identical semantics to
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -134,15 +135,25 @@ def _ru(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-# fmaj-vs-jmaj width slack: the fmaj broadcast expand keeps only int8 in
-# VMEM, while jmaj materializes an int32 [Wp, BN] block — measured round 4
-# at +19% for fmaj at EQUAL width, and the one-class Cramér gram (jmaj,
-# wp=256) ran at ~33 effective TOPS against the 115-125 TOPS the fmaj
-# W=384 gram sustains, i.e. jmaj's expand overhead dwarfs a ≤1.5× wider
-# dot at these widths.  So fmaj is preferred unless its padding widens
-# the gram by MORE than this factor (round 7; the Cramér family shape
-# 10×20×1 — wp 384 vs 256 — now rides fmaj).
-_FMAJ_WIDEN = 1.5
+# Width-slack factor, shared by two routing decisions that trade a wider
+# gram against a cheaper program:
+#
+# - fmaj-vs-jmaj (round 7): the fmaj broadcast expand keeps only int8 in
+#   VMEM, while jmaj materializes an int32 [Wp, BN] block — measured
+#   round 4 at +19% for fmaj at EQUAL width, and the one-class Cramér
+#   gram (jmaj, wp=256) ran at ~33 effective TOPS against the 115-125
+#   TOPS the fmaj W=384 gram sustains, i.e. jmaj's expand overhead
+#   dwarfs a ≤1.5× wider dot at these widths.  So fmaj is preferred
+#   unless its padding widens the gram by MORE than this factor (the
+#   Cramér family shape 10×20×1 — wp 384 vs 256 — now rides fmaj).
+# - the PackGraft cost model (round 16, :func:`pack_tables`): one joint
+#   gram dispatch replaces the chunked-einsum fold's per-table one-hot
+#   contractions when the padded gram width stays within this slack of
+#   the unpacked fold's per-row cell volume — the same "a modestly wider
+#   dot beats a cheaper-on-paper but scatter-lowered program" judgment,
+#   anchored by the measured packed-vs-unpacked fold A/B
+#   (benchmarks/wide_schema_bench.py --path pack).
+WIDTH_SLACK = 1.5
 
 
 def plan(num_feat: int, num_bins: int, num_classes: int):
@@ -150,10 +161,10 @@ def plan(num_feat: int, num_bins: int, num_classes: int):
 
     ``fmaj``: w = f·jcp + (bin·C + cls), jcp = jc rounded up to 32 (clean
     int8 tiling for the broadcast expand).  Chosen unless that padding
-    would widen the padded gram (wp) by more than ``_FMAJ_WIDEN`` versus
+    would widen the padded gram (wp) by more than ``WIDTH_SLACK`` versus
     the j-major packing — the dot is the dominant cost at large widths,
     but at kernel-eligible widths the int8-only expand buys back a
-    modestly wider gram (see _FMAJ_WIDEN).
+    modestly wider gram (see WIDTH_SLACK).
 
     ``cls`` (wide shapes): G is [C, wp, wp] with per-class row index
     w = bin·F + f (j-major within the class) — the per-class gram split
@@ -163,7 +174,7 @@ def plan(num_feat: int, num_bins: int, num_classes: int):
     jcp32 = _ru(jc, 32)
     wp32 = _ru(num_feat * jcp32, 128)
     wpj = _ru(num_feat * jc, 128)
-    if wp32 <= wpj or (wp32 <= MAX_W and wp32 <= _FMAJ_WIDEN * wpj):
+    if wp32 <= wpj or (wp32 <= MAX_W and wp32 <= WIDTH_SLACK * wpj):
         narrow = ("fmaj", jcp32, wp32)
     else:
         narrow = ("jmaj", jc, wpj)
@@ -620,6 +631,125 @@ def gram_moments(codes: jax.Array, labels: jax.Array, cont: jax.Array,
     return g, cnt, s1, s2
 
 
+def _gram_block_rows(num_feat: int, depth: int, wp: int) -> int:
+    """Row block for the einsum gram: bounded by a ~64 MB f32 intermediate
+    budget (the [br, F, depth] one-hot plus the [br, wp] layout view and
+    its dot operand copy) AND by 2^16 so every per-block f32 matmul sum is
+    integer-exact with margin (counts ≤ br « 2^24)."""
+    per_row = 4 * max(num_feat * depth + 2 * wp, 1)
+    return max(256, min(1 << 16, (1 << 26) // per_row) // 128 * 128)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_bins", "num_classes", "block_rows"))
+def gram_counts_cols(codes_t: jax.Array, labels: jax.Array, num_bins: int,
+                     num_classes: int, *,
+                     block_rows: int | None = None) -> jax.Array:
+    """The co-occurrence gram G as ONE exact einsum dispatch — the packed
+    fold's device program (PackGraft, round 16) for hosts where the Pallas
+    kernel doesn't run (the chunked-einsum routing's territory).
+
+    Bit-identical to :func:`cooc_counts_cols` for EVERY plan mode: the
+    one-hot X is laid out per :func:`plan`/:func:`w_index` (fmaj
+    w = f·jcp + (bin·C + cls); jmaj w = (bin·C + cls)·F + f; cls/clsb
+    per-class w = bin·F + f with G [C, wp, wp]), pad cells stay exactly
+    zero, out-of-range codes drop per-feature and out-of-range labels
+    drop the whole row — the drop-invalid contract.  Rows are processed
+    in f32-exact blocks with int32 accumulation (the same exactness
+    argument as ``models/tree.py::node_bin_class_counts``), so any N is
+    exact.
+
+    Versus the chunked-einsum fold this ONE [br, wp]ᵀ[br, wp] matmul
+    replaces the per-table one-hot contractions XLA lowers to
+    scatter-adds — the packing planner (:func:`pack_tables`) decides when
+    that trade pays."""
+    f, n = codes_t.shape
+    mode, jcp, wp = plan(f, num_bins, num_classes)
+    cls_mode = mode in ("cls", "clsb")
+    out_shape = (num_classes, wp, wp) if cls_mode else (wp, wp)
+    if n == 0:
+        return jnp.zeros(out_shape, jnp.int32)
+    jc = num_bins * num_classes
+    depth = (wp // f if mode == "clsb" else
+             num_bins if mode == "cls" else
+             jcp if mode == "fmaj" else jc)
+    br = block_rows or _gram_block_rows(f, depth, wp)
+    ct = codes_t.astype(jnp.int32)
+    y = labels.astype(jnp.int32)
+    npad = _ru(n, br)
+    if npad > n:
+        # pad rows carry label −1: the row-validity mask below drops them
+        # from every mode, so padding is pure shape ballast
+        ct = jnp.pad(ct, ((0, 0), (0, npad - n)), constant_values=_INVALID)
+        y = jnp.pad(y, (0, npad - n), constant_values=-1)
+    lanes = jnp.arange(depth)
+
+    def block_joint(cb, yb):
+        # joint code j = bin·C + cls; invalid labels kill the whole row,
+        # out-of-range codes kill the cell — the compare against the lane
+        # iota then leaves those one-hot rows all-zero (j = −1)
+        ok = ((yb >= 0) & (yb < num_classes))[None, :] \
+            & (cb >= 0) & (cb < num_bins)
+        j = jnp.where(ok, cb * num_classes + yb[None, :], -1)   # [F, br]
+        oh = (j[:, :, None] == lanes).astype(jnp.float32)       # [F, br, d]
+        if mode == "fmaj":
+            x = oh.transpose(1, 0, 2).reshape(br, f * depth)
+        else:
+            x = oh.transpose(1, 2, 0).reshape(br, depth * f)
+        if wp > x.shape[1]:
+            x = jnp.pad(x, ((0, 0), (0, wp - x.shape[1])))
+        return jnp.dot(x.T, x, precision="highest").astype(jnp.int32)
+
+    def block_cls(cb, yb):
+        code = jnp.where((cb >= 0) & (cb < num_bins), cb, -1)   # [F, br]
+        oh = (code[:, :, None] == lanes).astype(jnp.float32)    # [F, br, d]
+        x = oh.transpose(1, 2, 0).reshape(br, depth * f)        # w = b·F + f
+        if wp > x.shape[1]:                    # cls pads past F·B, at the end
+            x = jnp.pad(x, ((0, 0), (0, wp - x.shape[1])))
+        gs = []
+        for c in range(num_classes):
+            xc = x * (yb == c).astype(jnp.float32)[:, None]
+            gs.append(jnp.dot(xc.T, xc,
+                              precision="highest").astype(jnp.int32))
+        return jnp.stack(gs)
+
+    block = block_cls if cls_mode else block_joint
+    g, _ = jax.lax.scan(
+        lambda acc, xs: (acc + block(xs[0], xs[1]), None),
+        jnp.zeros(out_shape, jnp.int32),
+        (ct.reshape(f, npad // br, br).transpose(1, 0, 2),
+         y.reshape(npad // br, br)))
+    return g
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_bins", "num_classes", "block_rows"))
+def gram_counts(codes: jax.Array, labels: jax.Array, num_bins: int,
+                num_classes: int, *,
+                block_rows: int | None = None) -> jax.Array:
+    """Row-major wrapper of :func:`gram_counts_cols` (codes [N, F]) — the
+    packed ChunkFolder step's entry, mirroring :func:`cooc_counts`."""
+    return gram_counts_cols.__wrapped__(codes.T, labels, num_bins,
+                                        num_classes, block_rows=block_rows)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_bins", "num_classes", "block_rows"))
+def gram_counts_moments(codes: jax.Array, labels: jax.Array,
+                        cont: jax.Array, num_bins: int, num_classes: int, *,
+                        block_rows: int | None = None):
+    """Packed-fold analog of :func:`gram_moments`: the einsum gram PLUS
+    the class-conditional continuous moments of the same resident chunk,
+    one compiled program — so a packed SharedScan chunk pays one dispatch
+    exactly like the kernel fast path does."""
+    from avenir_tpu.ops import agg
+
+    g = gram_counts_cols.__wrapped__(codes.T, labels, num_bins, num_classes,
+                                     block_rows=block_rows)
+    cnt, s1, s2 = agg.class_moments.__wrapped__(cont, labels, num_classes)
+    return g, cnt, s1, s2
+
+
 def counts_from_cooc(g, num_feat: int, num_bins: int, num_classes: int,
                      ci, cj):
     """Host-side (numpy) read-out of the reference-shaped count tensors
@@ -650,6 +780,212 @@ def counts_from_cooc(g, num_feat: int, num_bins: int, num_classes: int,
     pair = g[np.broadcast_to(wi, (p, b, b, c)),
              np.broadcast_to(wj, (p, b, b, c))]
     return fbc, pair
+
+
+# ---------------------------------------------------------------------------
+# PackGraft (round 16): block-diagonal gram packing.
+#
+# The efficiency-vs-width curve (BASELINE.md wide-schema tier: ~77% of int8
+# peak at per-class widths ≥ 2000 vs 18-30% at the flagship W=384) makes
+# joint width the biggest single-chip lever.  A pack descriptor lays several
+# INDEPENDENT narrow tables' one-hot blocks along ONE joint width so all of
+# them ride a single wide gram dispatch:
+#
+#   · cross pack (pack_tables): the members are the FEATURES of one dataset
+#     — i.e. the ordinary joint gram G over all features at once, whose
+#     off-diagonal blocks are exactly the MI pair tables and whose diagonal
+#     blocks are the NB / against-class tables.  "Packing" NB + MI +
+#     correlation is then just routing the fold onto ONE G instead of the
+#     per-table scatter einsums; byte-identity is by construction
+#     (counts_from_cooc reads the same cells the per-table einsums build).
+#   · disjoint pack (pack_disjoint): the members are ROW-DISJOINT selectors
+#     (e.g. one tree-frontier node per row).  Each member gets a bin STRIPE
+#     of the joint bin axis (offset = m·stripe_bins); composite codes
+#     code + offset keep every cross-member block structurally zero because
+#     no row carries two members.  On clsb the stripe is rounded up to whole
+#     bands so members never straddle a band.
+#
+# The planners return a PackPlan (hashable — usable as a jit static) and the
+# pack either routes onto the EXISTING kernels (cooc_counts_cols — the
+# joint shape picks its own fmaj/cls/clsb mode, including the banded clsb
+# tier) or onto gram_counts_cols, the exact einsum gram, off-TPU.  Packed
+# g_keys share the kernel g_key's byte layout but carry a "packed" base so
+# checkpoint provenance stays visible to ChunkFolder's foreign-key refusal;
+# mesh suffixes attach behind the base exactly as for kernel keys.
+# ---------------------------------------------------------------------------
+
+
+class PackMember(NamedTuple):
+    """One table riding a pack: its (F, B, C) shape plus where its block
+    starts — a width offset (first w cell) for a cross pack, a bin-stripe
+    offset (joint bin = offset + local bin) for a disjoint pack."""
+    key: str
+    num_feat: int
+    num_bins: int
+    num_classes: int
+    offset: int
+
+
+class PackPlan(NamedTuple):
+    """Descriptor of one packed dispatch: the members plus the JOINT
+    (F, B, C) shape handed to plan()/the kernels.  Hashable by
+    construction so it can ride jit static_argnames."""
+    members: Tuple[PackMember, ...]
+    num_feat: int
+    num_bins: int          # JOINT bins (disjoint: n_members · stripe_bins)
+    num_classes: int
+    mode: str              # plan() mode of the joint shape
+    wp: int                # padded joint width
+    band_bins: int         # clsb band size in bins (0 otherwise)
+    stripe_bins: int       # disjoint packs: per-member bin stride, else 0
+    disjoint: bool
+
+    @property
+    def signature(self) -> str:
+        """Composite pack identity for telemetry program registration:
+        (site, signature) attributes roofline MFU to THIS packed shape."""
+        tag = "d" if self.disjoint else "x"
+        return (f"{self.mode}:{tag}{len(self.members)}:f{self.num_feat}"
+                f":b{self.num_bins}:c{self.num_classes}:w{self.wp}")
+
+    @property
+    def g_key(self) -> str:
+        """Checkpoint key of the packed G accumulator — same byte layout
+        as g_key(joint shape) (same plan(), same w_index cells), distinct
+        base so provenance survives kill-packed → resume-unpacked."""
+        return (f"g:packed:{self.mode}:f{self.num_feat}"
+                f":b{self.num_bins}:c{self.num_classes}")
+
+
+def packed_g_key(num_feat: int, num_bins: int, num_classes: int) -> str:
+    """The packed-provenance g_key for a joint shape — what a packed
+    ChunkFolder writes where an unpacked gram folder writes g_key().
+    Byte layout is IDENTICAL to g_key(F, B, C) (both are plan()'s G for
+    the same joint shape); only the base string differs, so adopt_state
+    can normalize between the two while foreign LAYOUTS still refuse."""
+    mode, _, _ = plan(num_feat, num_bins, num_classes)
+    return f"g:packed:{mode}:f{num_feat}:b{num_bins}:c{num_classes}"
+
+
+def pack_tables(num_feat: int, num_bins: int, num_classes: int,
+                num_pairs: int, max_width: Optional[int] = None
+                ) -> Optional[PackPlan]:
+    """Cross-pack planner: fold NB ([F, B, C]) + P MI pair tables
+    ([B, B, C] each) + against-class stacks as ONE joint gram, or None
+    when the pack does not pay.
+
+    Cost model (shares WIDTH_SLACK with plan()'s fmaj routing): the
+    unpacked fold builds F·B + P·B·(1+C) one-hot-contracted cells per
+    class-expanded row; the packed gram pays wp² but rides the wide-gram
+    MXU tier, so pack iff  wp ≤ WIDTH_SLACK · (F·B + P·B·(1+C))  and wp
+    fits the clsb ceiling (the widest tier the kernel attests).  The
+    measured CPU einsum crossover (hosp 11×12×2, 55 pairs: 7.2×) sits
+    far above this gate; the gate's job is refusing packs where pad
+    cells dominate (e.g. pair-poor consumer sets)."""
+    if num_feat * num_bins * num_classes <= 0:
+        return None
+    mode, jcp, wp = plan(num_feat, num_bins, num_classes)
+    cap = min(max_width or MAX_W_CLSB, MAX_W_CLSB)
+    if wp > cap:
+        return None
+    cells = num_feat * num_bins + num_pairs * num_bins * (1 + num_classes)
+    if wp > WIDTH_SLACK * cells:
+        return None
+    wf = w_index(num_feat, num_bins, num_classes)
+    members = tuple(
+        PackMember(key=f"f{i}", num_feat=1, num_bins=num_bins,
+                   num_classes=num_classes, offset=int(wf[i].min()))
+        for i in range(num_feat))
+    band = clsb_tile(num_feat, num_bins, num_classes) if mode == "clsb" \
+        else None
+    return PackPlan(members=members, num_feat=num_feat, num_bins=num_bins,
+                    num_classes=num_classes, mode=mode, wp=wp,
+                    band_bins=(band[0] // num_feat if band else 0),
+                    stripe_bins=0, disjoint=False)
+
+
+def pack_disjoint(num_members: int, num_feat: int, num_bins: int,
+                  num_classes: int, max_width: Optional[int] = None
+                  ) -> Optional[PackPlan]:
+    """Disjoint-pack planner: M row-disjoint members (tree sibling nodes),
+    each an [F, B, C] table, as one joint gram over M·Bp bins where Bp is
+    B rounded up so clsb bands hold WHOLE members (a member never
+    straddles a band — its diagonal block stays inside one band and every
+    cross-member cell the banded kernel materializes is structurally
+    zero).  Returns None when the joint shape exceeds every tier or the
+    fixpoint between stripe rounding and clsb's tile choice diverges.
+
+    NOTE the FLOP trade: the joint gram pays ~M× the cells of M separate
+    grams (each member's rows also multiply the other members' all-zero
+    stripes) — worth it only to reach a faster width tier; callers gate
+    on packed_applicable()/platform (architecture.md "when packing does
+    NOT pay")."""
+    if num_members <= 0 or num_feat * num_bins * num_classes <= 0:
+        return None
+    bp = num_bins
+    mode = wp = None
+    for _ in range(4):                       # stripe↔band fixpoint, ≤4 hops
+        mode, _, wp = plan(num_feat, num_members * bp, num_classes)
+        if mode != "clsb":
+            break
+        tile = clsb_tile(num_feat, num_members * bp, num_classes)
+        if tile is None:
+            return None
+        k = tile[0] // num_feat              # band size in bins
+        bp2 = _ru(num_bins, k)
+        if bp2 == bp:
+            break
+        bp = bp2
+    else:
+        return None
+    cap = min(max_width or MAX_W_CLSB, MAX_W_CLSB)
+    if wp > cap or not (mode in ("cls", "clsb") or wp <= MAX_W):
+        return None
+    members = tuple(
+        PackMember(key=f"m{i}", num_feat=num_feat, num_bins=num_bins,
+                   num_classes=num_classes, offset=i * bp)
+        for i in range(num_members))
+    band = clsb_tile(num_feat, num_members * bp, num_classes) \
+        if mode == "clsb" else None
+    return PackPlan(members=members, num_feat=num_feat,
+                    num_bins=num_members * bp, num_classes=num_classes,
+                    mode=mode, wp=wp,
+                    band_bins=(band[0] // num_feat if band else 0),
+                    stripe_bins=bp, disjoint=True)
+
+
+@functools.partial(jax.jit, static_argnames=("stripe_bins", "member_bins"))
+def packed_codes(codes_t: jax.Array, member: jax.Array, stripe_bins: int,
+                 member_bins: int) -> jax.Array:
+    """Composite codes for a disjoint pack: joint bin = code + m·stripe.
+
+    The mask is against the member's OWN bin count, not the stripe: an
+    out-of-range local code must become −1 (dropped by the kernels'
+    drop-invalid contract), never bleed into the next member's stripe.
+    Rows with member −1 (e.g. tree rows not on the frontier) drop whole."""
+    ct = codes_t.astype(jnp.int32)
+    mem = member.astype(jnp.int32)
+    off = jnp.where(mem >= 0, mem * stripe_bins, 0)[None, :]
+    ok = (mem >= 0)[None, :] & (ct >= 0) & (ct < member_bins)
+    return jnp.where(ok, ct + off, -1)
+
+
+def packed_diag_index(pplan: PackPlan) -> np.ndarray:
+    """Host-side unpack index for a DISJOINT pack: w cells [F, B, M, C]
+    such that G[w, w] (per class for cls modes) is member m's [F, B, C]
+    table — the counts_from_cooc-style read-out at joint bin
+    offset_m + b."""
+    wf = w_index(pplan.num_feat, pplan.num_bins, pplan.num_classes)
+    b = pplan.members[0].num_bins
+    offs = np.array([mb.offset for mb in pplan.members], np.int64)
+    sel = offs[None, :] + np.arange(b)[:, None]              # [B, M]
+    return wf[:, sel, :]                                     # [F, B, M, C]
+
+
+def packed_applicable(pplan: PackPlan) -> bool:
+    """Kernel eligibility of the JOINT shape — the packed analog of
+    applicable(); routing also needs use_kernel()'s platform gates."""
+    return applicable(pplan.num_feat, pplan.num_bins, pplan.num_classes)
 
 
 def nb_mi_step(codes: jax.Array, labels: jax.Array, ci, cj,

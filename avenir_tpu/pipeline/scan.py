@@ -262,8 +262,10 @@ class ChunkFolder:
 
     Captures the fit-static routing ONCE from the consumer set and the
     stream's shape metadata — the count-path selection (kernel fast path,
-    sharded-kernel mesh path, or the einsum fallback: the SAME three-way
-    routing as ``MutualInformation.fit``), the layout-qualified gram key,
+    sharded-kernel mesh path, the PackGraft packed gram where the pack
+    planner decides one wide dispatch beats the per-table einsums, or the
+    einsum fallback: the standalone paths' routing plus the pack tier),
+    the layout-qualified gram key,
     the union of required pairs, and the moments flag — then folds any
     number of chunks into *caller-owned* :class:`~avenir_tpu.ops.agg.Accumulator`
     objects.  :class:`SharedScan` folds the whole stream into one
@@ -275,7 +277,9 @@ class ChunkFolder:
 
     def __init__(self, consumers: Sequence[ScanConsumer],
                  meta: EncodedDataset, mesh=None, pair_chunk: int = 256,
-                 shard=None, counters: Optional[Counters] = None):
+                 shard=None, counters: Optional[Counters] = None,
+                 pack_on: bool = True,
+                 pack_max_width: Optional[int] = None):
         from avenir_tpu.ops import pallas_hist
 
         if not consumers:
@@ -329,11 +333,30 @@ class ChunkFolder:
                 self.step = "sharded"
             else:
                 self.step = "einsum"
+        # PackGraft (round 16): where the per-table scatter einsums would
+        # run, the pack planner may coalesce NB + MI pair tables +
+        # against-class stacks into ONE wide block-diagonal gram dispatch
+        # (pallas_hist.gram_counts — the exact einsum gram) so every table
+        # rides the efficiency-vs-width curve.  Single-device only: the
+        # packed fold is one unsharded program (the mesh paths carry their
+        # own attested collectives).  Byte-identity is by construction —
+        # tables() reads the same counts_from_cooc cells either way.
+        self.pack = None
+        if self.step == "einsum" and pack_on and self.mesh is None:
+            pplan = pallas_hist.pack_tables(
+                f, b, c, len(self.pair_index), max_width=pack_max_width)
+            if pplan is not None:
+                self.step = "packed"
+                self.pack = pplan
         # mesh-qualified on the shard path: state folded under one topology
         # must never be silently summed under another (tables() raises on
-        # an orphaned g: key — the GL002 discipline applied to mesh shape)
-        self.gk = pallas_hist.g_key(f, b, c) + (
-            shard.g_suffix if self.step == "shard" else "")
+        # an orphaned g: key — the GL002 discipline applied to mesh shape).
+        # A packed fold writes the packed-provenance base — same G byte
+        # layout as the kernel key, distinct base string, so adopt_state
+        # can normalize between the two while foreign LAYOUTS still refuse.
+        self.gk = (self.pack.g_key if self.step == "packed"
+                   else pallas_hist.g_key(f, b, c) + (
+                       shard.g_suffix if self.step == "shard" else ""))
         # logical all-reduce payload per fused shard dispatch (telemetry):
         # the gram (int8+scales when quantized, int32 psum otherwise) plus
         # the class-count/moment psums.  A global plan pays TWO legs —
@@ -364,13 +387,25 @@ class ChunkFolder:
 
         self._prof = _profile.profiler()
 
+    @property
+    def program_tag(self) -> Optional[str]:
+        """Routing label for telemetry program registration.  Packed
+        routings carry the composite pack signature so GraftProf/roofline
+        attributes MFU to THIS packed shape, not a generic step name —
+        and so a pack-width change registers a distinct program."""
+        if self.step == "packed":
+            return f"packed:{self.pack.signature}"
+        return self.step
+
     def cost_probe(self, ds: EncodedDataset):
         """(lowerable, args) for this folder's per-chunk device program —
-        the GraftProf AOT cost hook.  Only the single-dispatch kernel
-        routings are probeable (the program IS the chunk pass); the
-        einsum fallback and the shard_map path dispatch several programs
-        per chunk, so they register shapes-only rather than publishing a
-        misleading single-program cost."""
+        the GraftProf AOT cost hook.  The single-dispatch routings are
+        probeable (kernel, and the packed gram whose ONE program IS the
+        chunk pass — a packed chunk must never degrade to
+        ``source:"shapes"``); the per-table einsum fallback and the
+        shard_map path dispatch several programs per chunk, so they
+        register shapes-only rather than publishing a misleading
+        single-program cost."""
         from avenir_tpu.ops import pallas_hist
 
         if self.step == "kernel":
@@ -378,6 +413,12 @@ class ChunkFolder:
                 return (pallas_hist.gram_moments,
                         (ds.codes, ds.labels, ds.cont, self.b, self.c))
             return (pallas_hist.cooc_counts,
+                    (ds.codes, ds.labels, self.b, self.c))
+        if self.step == "packed":
+            if self.needs_moments:
+                return (pallas_hist.gram_counts_moments,
+                        (ds.codes, ds.labels, ds.cont, self.b, self.c))
+            return (pallas_hist.gram_counts,
                     (ds.codes, ds.labels, self.b, self.c))
         return None
 
@@ -457,6 +498,22 @@ class ChunkFolder:
             else:
                 acc.add(self.gk, pallas_hist.cooc_counts(
                     codes, labels, self.b, self.c))
+        elif self.step == "packed":
+            # ONE wide block-diagonal gram dispatch standing in for the
+            # per-table einsum family below — same fused-moments shape as
+            # the kernel branch, exact by construction (gram_counts is
+            # bit-identical to the kernel's G)
+            if self.needs_moments:
+                g, cnt, s1, s2 = pallas_hist.gram_counts_moments(
+                    codes, labels, cont, self.b, self.c)
+                acc.add(self.gk, g)
+                acc.add("cont_count", cnt)
+                acc.add("cont_sum", s1)
+                acc.add("cont_sumsq", s2)
+                moments_done = True
+            else:
+                acc.add(self.gk, pallas_hist.gram_counts(
+                    codes, labels, self.b, self.c))
         elif self.step == "sharded":
             acc.add(self.gk, self._sharded(codes, labels))
         elif self.step == "einsum":
@@ -516,28 +573,42 @@ class ChunkFolder:
         Exact by construction: 64-bit host totals are mesh-shape-
         invariant, so re-keying ``:mesh:<axis><n>`` qualifiers moves the
         SAME bytes under the new topology's key (checkpoint/reshard.py).
-        Demotion onto the chunked-einsum routing converts the gram
-        through ``counts_from_cooc`` — the identical read-out
-        ``tables()`` itself runs.  Genuinely non-portable state raises
+        Packed↔unpacked is a PROVENANCE crossing, not a layout one — the
+        packed base ``g:packed:<mode>:...`` stores byte-for-byte the same
+        G as the kernel base for the same (F, B, C), so the base string
+        is normalized to this folder's own (kill-packed → resume-unpacked
+        and the reverse both redistribute exactly).  Demotion onto the
+        chunked-einsum routing converts either gram base through
+        ``counts_from_cooc`` — the identical read-out ``tables()`` itself
+        runs.  Genuinely non-portable state raises
         :class:`~avenir_tpu.checkpoint.reshard.ReshardError`: a foreign
-        base LAYOUT (the schema changed), mixed-topology state, or
-        einsum-chunked counts promoted onto a gram routing (pairs outside
-        the persisted union were never aggregated)."""
+        base LAYOUT (the schema changed), mixed-topology or
+        mixed-provenance state, or einsum-chunked counts promoted onto a
+        gram routing (pairs outside the persisted union were never
+        aggregated)."""
         from avenir_tpu.checkpoint import reshard
         from avenir_tpu.ops import pallas_hist
 
         reshard.state_suffix(state)         # refuse mixed-topology state
         base_gk = pallas_hist.g_key(self.f, self.b, self.c)
+        accepted = {base_gk,
+                    pallas_hist.packed_g_key(self.f, self.b, self.c)}
         gram_keys = [k for k in state
                      if isinstance(k, str) and k.startswith("g:")]
         for key in gram_keys:
             base, _ = reshard.split_mesh_key(key)
-            if base != base_gk:
+            if base not in accepted:
                 raise reshard.ReshardError(
                     f"gram state {key!r} has base layout {base!r} but "
                     f"this fold's is {base_gk!r} — the kernel layout "
                     f"(schema shape F/B/C) changed; no redistribution "
                     f"can reconcile different layouts")
+        if len(gram_keys) > 1:
+            raise reshard.ReshardError(
+                f"state holds gram counts under {sorted(gram_keys)} — "
+                f"mixed kernel/packed provenance in one mapping means "
+                f"the same rows were split across two accumulators; "
+                f"redistribution cannot prove they partition the stream")
         if gram_keys and "fc" in state:
             raise reshard.ReshardError(
                 f"state holds both gram {gram_keys[0]!r} and einsum 'fc' "
@@ -564,7 +635,21 @@ class ChunkFolder:
                 "gram — pair counts outside the persisted union were "
                 "never aggregated, so promotion is impossible; restore "
                 "on an einsum-routed topology or start clean")
-        return reshard.rekey_state(state, self.g_suffix)
+        # provenance normalization: at most ONE gram key survives the
+        # checks above (one topology, one base) — rename its base to this
+        # routing's own (packed↔kernel store identical G bytes for one
+        # (F, B, C)), then let reshard move the mesh suffix
+        renamed: List[str] = []
+        own_base = self.pack.g_key if self.step == "packed" else base_gk
+        if gram_keys:
+            (key,) = gram_keys
+            base, suffix = reshard.split_mesh_key(key)
+            if base != own_base:
+                state = {(own_base + suffix if k == key else k): v
+                         for k, v in state.items()}
+                renamed = [key]
+        out, moved = reshard.rekey_state(state, self.g_suffix)
+        return out, renamed + moved
 
     def tables(self, acc: agg.Accumulator, rows: int) -> ScanTables:
         """The shared per-stream totals from an accumulator this folder
@@ -641,12 +726,16 @@ class SharedScan:
     """
 
     def __init__(self, mesh=None, pair_chunk: int = 256, shard=None,
-                 counters: Optional[Counters] = None):
+                 counters: Optional[Counters] = None, pack_on: bool = True,
+                 pack_max_width: Optional[int] = None):
         self.mesh = mesh
         self.pair_chunk = pair_chunk
         self.shard = shard                # parallel/shard.ShardSpec or None
         self.counters = counters
+        self.pack_on = pack_on            # scan.pack.on
+        self.pack_max_width = pack_max_width   # scan.pack.max.width
         self.chunks_seen = 0              # set by run(); fused stages report it
+        self.count_path = None            # routing tag of the last run()
         self._consumers: List[ScanConsumer] = []
 
     def register(self, consumer: ScanConsumer) -> ScanConsumer:
@@ -670,7 +759,8 @@ class SharedScan:
                 "class-conditioned (see the row-validity contract)")
         folder = ChunkFolder(self._consumers, meta, mesh=self.mesh,
                              pair_chunk=self.pair_chunk, shard=self.shard,
-                             counters=self.counters)
+                             counters=self.counters, pack_on=self.pack_on,
+                             pack_max_width=self.pack_max_width)
         from avenir_tpu.telemetry import profile as _profile
         from avenir_tpu.telemetry import spans as tel
 
@@ -679,8 +769,9 @@ class SharedScan:
         acc = agg.Accumulator()
         rows = 0
         self.chunks_seen = 0
+        self.count_path = folder.program_tag or "moments"
         attrs = {"consumers": [x.name for x in self._consumers],
-                 "path": folder.step or "moments"}
+                 "path": folder.program_tag or "moments"}
         if self.shard is not None:
             attrs["shard.devices"] = self.shard.num_devices
             attrs["shard.axis"] = self.shard.data_axis
@@ -698,9 +789,12 @@ class SharedScan:
                     # GraftProf: the fold program — registered with AOT
                     # cost where the routing is single-dispatch, sampled
                     # per chunk so the profile table knows this seam
+                    # packed programs register under the composite
+                    # (shape, pack-signature) key — the roofline table
+                    # attributes MFU to the packed dispatch itself
                     pkey = tel.CompileKeyMonitor.shape_key(
                         ds.codes, ds.labels, ds.cont) + (
-                        folder.step or "moments",)
+                        folder.program_tag or "moments",)
                     probe = folder.cost_probe(ds)
                     chunk_attrs["program"] = prof.observe(
                         pkey, site="scan.chunk",
@@ -740,7 +834,8 @@ FUSABLE_JOBS = ("BayesianDistribution", "MutualInformation",
 _COMPAT_KEYS = ("feature.schema.file.path", "field.delim.regex",
                 "field.delim", "stream.chunk.rows", "stream.prefetch.depth",
                 "data.parallel.auto", "shard.devices", "shard.data.axis",
-                "shard.allreduce.quantized", "shard.proc.axis")
+                "shard.allreduce.quantized", "shard.proc.axis",
+                "scan.pack.on", "scan.pack.max.width")
 
 
 def stage_fusable(job, conf) -> bool:
@@ -823,8 +918,10 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
         spec.announce()       # deduped per journal — one event per run
     enc, data, rows_fn = job_obj.encoded_data_source(
         first_conf, in_path, counters[stages[0][0]], mesh=mesh, shard=spec)
-    engine = SharedScan(mesh=mesh, shard=spec,
-                        counters=counters[stages[0][0]])
+    engine = SharedScan(
+        mesh=mesh, shard=spec, counters=counters[stages[0][0]],
+        pack_on=first_conf.get_bool("scan.pack.on", True),
+        pack_max_width=first_conf.get_int("scan.pack.max.width", 0) or None)
     writers = {}
     for name, job, _inp, out_path, conf in stages:
         if job == "BayesianDistribution":

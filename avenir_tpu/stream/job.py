@@ -113,7 +113,9 @@ class StreamAnalytics(Job):
             counters=counters, checkpointer=ckpt,
             crash_after_panes=conf.get_int("stream.fault.crash.after.panes",
                                            0),
-            on_window=handle, fault=fault)
+            on_window=handle, fault=fault,
+            pack_on=conf.get_bool("scan.pack.on", True),
+            pack_max_width=conf.get_int("scan.pack.max.width", 0) or None)
         skip = ckpt.restore_into(ws) if ckpt is not None else 0
         if conf.get_bool("stream.warmup.on.start", True):
             ws.warm()

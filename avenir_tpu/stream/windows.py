@@ -152,7 +152,8 @@ class WindowedScan:
                  counters: Optional[Counters] = None,
                  checkpointer: Optional["WindowCheckpointer"] = None,
                  crash_after_panes: int = 0, on_window=None, shard=None,
-                 fault=None):
+                 fault=None, pack_on: bool = True,
+                 pack_max_width: Optional[int] = None):
         if not encoder.schema_complete(with_labels=True) or \
                 not encoder.class_values:
             raise ConfigError(
@@ -194,8 +195,12 @@ class WindowedScan:
         # ChunkFolder — no stream-side parallel code at all); the fold
         # ballast-pads each pow-2 pane on to its shard target, so the
         # compiled-shape set stays finite and warm() covers it
+        # PackGraft (round 16): panes inherit block-diagonal gram packing
+        # through ChunkFolder's pack planner — zero stream-side fold code
         self.folder = scan.ChunkFolder(consumers, self.meta, mesh=mesh,
-                                       shard=shard, counters=self.counters)
+                                       shard=shard, counters=self.counters,
+                                       pack_on=pack_on,
+                                       pack_max_width=pack_max_width)
         self.buckets = _pow2_buckets(self.pane_rows)
         self._monitor = tel.CompileKeyMonitor(self.counters, group="Stream",
                                               scope="stream.pane")
@@ -213,13 +218,35 @@ class WindowedScan:
         nothing counts) and prime the recompile monitor; after this,
         steady-state panes — ragged tails included — must recompile zero
         times.  Returns the number of shapes warmed."""
+        from avenir_tpu.telemetry import profile as _profile
+
+        prof = _profile.profiler()
         throwaway = agg.Accumulator()
         for bucket in self.buckets:
             ds = self._blank_pane(bucket)
-            self._monitor.prime([tel.CompileKeyMonitor.shape_key(
-                ds.codes, ds.labels, ds.cont)])
+            key = self._pane_key(ds)
+            if prof.enabled:
+                # AOT cost-probe BEFORE the prime: the profiler keeps the
+                # FIRST (site, key) observation, and the prime registers
+                # shapes-only — a packed/kernel pane must never degrade
+                # to source:"shapes" just because warm() ran first
+                probe = self.folder.cost_probe(ds)
+                if probe is not None:
+                    prof.observe(key, site=self._monitor.scope,
+                                 lowerable=probe[0], args=probe[1])
+            self._monitor.prime([key])
             self.folder.fold(ds, throwaway)
         return len(self.buckets)
+
+    def _pane_key(self, ds: EncodedDataset):
+        """The pane's compile/program key: dispatch shapes + the folder's
+        routing tag — packed panes register under the composite
+        (site, pack-signature) identity, so the roofline table attributes
+        MFU to the packed dispatch and a pack-width change is a fresh
+        program, not a silent recompile of the old one."""
+        return tel.CompileKeyMonitor.shape_key(
+            ds.codes, ds.labels, ds.cont) + (
+            self.folder.program_tag or "moments",)
 
     def _blank_pane(self, n: int) -> EncodedDataset:
         m = self.meta
@@ -278,10 +305,17 @@ class WindowedScan:
                 self.fault.hit("fold")
             ds = self._encode(lines)
             ds = self._pad(ds)
-            key = tel.CompileKeyMonitor.shape_key(
-                ds.codes, ds.labels, ds.cont)
+            key = self._pane_key(ds)
             # the monitor's key feed doubles as the GraftProf program
-            # registration (site = this monitor's scope)
+            # registration (site = this monitor's scope); the cost probe
+            # runs first — first observation wins, and an unwarmed pane
+            # shape must still register with AOT cost where the routing
+            # is single-dispatch
+            if prof.enabled:
+                probe = self.folder.cost_probe(ds)
+                if probe is not None:
+                    prof.observe(key, site=self._monitor.scope,
+                                 lowerable=probe[0], args=probe[1])
             self._monitor.observe([key])
             t0 = time.perf_counter()
             self.folder.fold(ds, acc)

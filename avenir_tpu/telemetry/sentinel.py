@@ -29,6 +29,7 @@ All metrics here are rates (higher is better); a regression is
 
 from __future__ import annotations
 
+import fnmatch
 import json
 from typing import Dict, List, Optional
 
@@ -66,6 +67,23 @@ def _canary_flagged(row: dict) -> bool:
     return False
 
 
+def _row_entry(row: dict) -> Optional[dict]:
+    """One comparable row honoring the ``value_canary_clean`` convention
+    (field present → IT is the value, null → flagged; absent → raw value
+    conditioned on the row's own canary readings).  None = no row."""
+    flagged = False
+    value = row.get("value")
+    if "value_canary_clean" in row:
+        value = row.get("value_canary_clean")
+        flagged = value is None
+    elif _canary_flagged(row):
+        flagged = True
+    if isinstance(value, (int, float)) or flagged:
+        return {"value": value, "unit": row.get("unit"),
+                "canary_flagged": flagged}
+    return None
+
+
 def extract_metrics(artifact: dict) -> Dict[str, dict]:
     """``{metric name: {value, unit, canary_flagged}}`` from a bench line
     (or driver wrapper).  The primary metric honors the
@@ -78,16 +96,9 @@ def extract_metrics(artifact: dict) -> Dict[str, dict]:
     if not isinstance(line.get("metric"), str):
         return out
 
-    flagged = False
-    value = line.get("value")
-    if "value_canary_clean" in line:
-        value = line.get("value_canary_clean")
-        flagged = value is None
-    elif _canary_flagged(line):
-        flagged = True
-    if isinstance(value, (int, float)) or flagged:
-        out[line["metric"]] = {"value": value, "unit": line.get("unit"),
-                               "canary_flagged": flagged}
+    entry = _row_entry(line)
+    if entry is not None:
+        out[line["metric"]] = entry
 
     knn = line.get("knn")
     if isinstance(knn, dict) and isinstance(knn.get("value"), (int, float)):
@@ -103,6 +114,20 @@ def extract_metrics(artifact: dict) -> Dict[str, dict]:
                 out[f"families.{fam}"] = {
                     "value": row["value"], "unit": row.get("unit"),
                     "canary_flagged": _canary_flagged(row)}
+
+    # PackGraft (round 16): the wide_schema --path pack sweep publishes a
+    # nested "packed" block — per-row dicts keyed by sub-metric name,
+    # each honoring the same value_canary_clean/per-pass conventions as
+    # the primary (pack_speedup carries no canary fields by design: both
+    # sides of the ratio share the rig, so contention divides out)
+    packed = line.get("packed")
+    if isinstance(packed, dict):
+        for name in sorted(packed):
+            row = packed[name]
+            if isinstance(row, dict):
+                entry = _row_entry(row)
+                if entry is not None:
+                    out[f"packed.{name}"] = entry
     return out
 
 
@@ -116,13 +141,22 @@ def evaluate(current: dict, baseline: dict,
     (nothing comparable survived canary conditioning) / ``no_baseline``
     (the baseline carries no comparable metrics — e.g. a bands-less
     BASELINE.json).  Per-row verdicts: ``pass``, ``regression``,
-    ``skipped_canary`` (either side flagged), ``no_baseline``, and
-    ``missing`` — a metric the baseline gates but the capture no longer
-    emits, which fails the gate like a regression (a capture that
-    silently stops producing a gated row must not pass by omission)."""
+    ``skipped_canary`` (either side flagged), ``no_baseline``,
+    ``skipped_optional``, and ``missing`` — a metric the baseline gates
+    but the capture no longer emits, which fails the gate like a
+    regression (a capture that silently stops producing a gated row must
+    not pass by omission).  The baseline may declare
+    ``{"sentinel": {"optional": ["packed.*", ...]}}`` glob patterns:
+    bands for rows only SOME benchmarks emit (the packed sweep's) — an
+    absent optional row is ``skipped_optional`` instead of failing every
+    capture from a benchmark that never produces it, but it IS still
+    compared whenever present."""
     cur = extract_metrics(current)
     base = extract_metrics(baseline)
     per_metric = per_metric or {}
+    gates = _line(baseline).get("sentinel")
+    optional = (gates.get("optional", [])
+                if isinstance(gates, dict) else [])
     rows: List[dict] = []
     regressed: List[str] = []
     skipped: List[str] = []
@@ -130,6 +164,14 @@ def evaluate(current: dict, baseline: dict,
     compared = 0
     for name in base:
         if name not in cur:
+            if any(fnmatch.fnmatch(name, pat) for pat in optional
+                   if isinstance(pat, str)):
+                skipped.append(name)
+                rows.append({"metric": name, "value": None,
+                             "baseline": base[name]["value"],
+                             "tolerance_pct": None, "ratio": None,
+                             "verdict": "skipped_optional"})
+                continue
             missing.append(name)
             rows.append({"metric": name, "value": None,
                          "baseline": base[name]["value"],

@@ -10,8 +10,21 @@ data, fresh-process, chained-dispatch host-fetch sync.
 
   python benchmarks/wide_schema_bench.py --shape 20x20x2 --path kernel
   python benchmarks/wide_schema_bench.py --shape 24x32x2 --path einsum
+  python benchmarks/wide_schema_bench.py --shape 11x12x2 --path pack
 
 One (shape, path) per process run (fresh-process discipline).
+
+``--path pack`` (PackGraft, round 16) times BOTH sides of the packing
+decision on the same data — the unpacked per-table einsum fold
+(fc + 256-pair slices, ChunkFolder's einsum step) vs the ONE packed
+block-diagonal gram (``pallas_hist.gram_counts`` on CPU /
+``cooc_counts`` where the joint shape rides the kernel) — publishing
+packed-vs-unpacked efficiency points along the width curve.  Byte
+identity is asserted BEFORE any rate (``counts_from_cooc`` vs the
+einsum tensors), every pass carries a rig canary reading, and the
+conditioned ``value_canary_clean`` convention applies; ``pack_speedup``
+carries no canary fields — both sides share the rig, so contention
+divides out of the ratio.
 """
 
 import argparse
@@ -28,12 +41,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--shape", default="20x20x2",
                     help="FxBxC, e.g. 20x20x2 (W=800) or 24x32x2 (W=1536)")
-    ap.add_argument("--path", choices=["kernel", "einsum"], default="kernel")
+    ap.add_argument("--path", choices=["kernel", "einsum", "pack"],
+                    default="kernel")
     ap.add_argument("--rows", type=int, default=4_000_000)
     ap.add_argument("--chunks", type=int, default=4)
     ap.add_argument("--passes", type=int, default=4)
     args = ap.parse_args()
     f, b, c = (int(x) for x in args.shape.split("x"))
+    if args.path == "pack":
+        return pack_main(args, f, b, c)
 
     rng = np.random.default_rng(0)
     codes = rng.integers(0, b, size=(args.rows, f), dtype=np.int32)
@@ -102,6 +118,117 @@ def main():
     if args.path == "kernel":
         line["plan"] = list(pallas_hist.plan(f, b, c))
     print(json.dumps(line))
+
+
+def pack_main(args, f, b, c):
+    """The --path pack sweep: unpacked per-table einsum fold vs the ONE
+    packed gram, same data, byte-identity asserted before any timing."""
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
+
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, b, size=(args.rows, f), dtype=np.int32)
+    labels = rng.integers(0, c, size=args.rows, dtype=np.int32)
+    pi = np.array([(i, j) for i in range(f) for j in range(i + 1, f)],
+                  np.int32).reshape(-1, 2)
+    pplan = pallas_hist.pack_tables(f, b, c, len(pi))
+    if pplan is None:
+        raise SystemExit(f"shape {args.shape} fails the pack gate "
+                         f"(wp > WIDTH_SLACK * unpacked cells) — nothing "
+                         f"to measure; pick a pair-rich shape")
+    kernel = (pallas_hist.packed_applicable(pplan)
+              and pallas_hist.on_tpu_single_device())
+    dcodes = jnp.asarray(codes)
+    dlabels = jnp.asarray(labels)
+    pair_chunk = 256
+    slices = [(jnp.asarray(pi[s:s + pair_chunk, 0]),
+               jnp.asarray(pi[s:s + pair_chunk, 1]))
+              for s in range(0, len(pi), pair_chunk)]
+
+    def unpacked_step(bias):
+        y = dlabels + bias
+        fc = agg.feature_class_counts(dcodes, y, c, b)
+        outs = [agg.pair_class_counts(dcodes[:, si], dcodes[:, sj], y, c, b)
+                for si, sj in slices]
+        return fc, outs
+
+    def packed_step(bias):
+        if kernel:
+            return pallas_hist.cooc_counts(dcodes, dlabels + bias, b, c)
+        return pallas_hist.gram_counts(dcodes, dlabels + bias, b, c)
+
+    # byte-identity BEFORE any rate: the packed G's counts_from_cooc
+    # read-out must equal the per-table einsum fold cell-for-cell
+    fc0, pair_parts = unpacked_step(jnp.int32(0))
+    fbc_u = np.asarray(fc0, np.int64)
+    pcc_u = np.concatenate([np.asarray(p, np.int64) for p in pair_parts])
+    fbc_p, pcc_p = pallas_hist.counts_from_cooc(
+        np.asarray(packed_step(jnp.int32(0))), f, b, c, pi[:, 0], pi[:, 1])
+    assert np.array_equal(fbc_u, fbc_p), "packed fbc diverges from einsum"
+    assert np.array_equal(pcc_u, pcc_p), "packed pair tensor diverges"
+
+    def chain_unpacked(out):
+        return ((out[0][0, 0, 0] + out[1][-1][0, 0, 0, 0]) * 0).astype(
+            jnp.int32)
+
+    def chain_packed(out):
+        flat = out.reshape(-1)
+        return (flat[0] * 0).astype(jnp.int32)
+
+    def timed_pass(step, chain):
+        bias = jnp.int32(0)
+        t0 = time.perf_counter()
+        for _ in range(args.chunks):
+            bias = chain(step(bias))
+        np.asarray(bias)
+        return args.chunks * args.rows / (time.perf_counter() - t0)
+
+    results = {}
+    canary_per_pass = []
+    for name, step, chain in (("unpacked", unpacked_step, chain_unpacked),
+                              ("packed", packed_step, chain_packed)):
+        timed_pass(step, chain)
+        timed_pass(step, chain)
+        passes = []
+        for _ in range(args.passes):
+            canary_per_pass.append(matmul_canary_ms())
+            passes.append(timed_pass(step, chain))
+        results[name] = passes
+
+    from avenir_tpu.telemetry.sentinel import CANARY_HEALTHY_MS
+    med_u = float(np.median(results["unpacked"]))
+    med_p = float(np.median(results["packed"]))
+    clean = min(canary_per_pass) <= CANARY_HEALTHY_MS
+    mode, _, wp = pallas_hist.plan(f, b, c)
+    cells = f * b + len(pi) * b * (1 + c)
+    print(json.dumps({
+        "metric": "nb_mi_wide_schema_throughput",
+        "shape": args.shape, "w": f * b * c, "path": "pack",
+        "value": round(med_p, 1), "unit": "rows/sec/chip",
+        "value_canary_clean": round(med_p, 1) if clean else None,
+        "canary_per_pass_ms": [round(x, 2) for x in canary_per_pass],
+        "passes_rows_per_sec": [round(p, 1) for p in results["packed"]],
+        "plan": [mode, wp], "pack_signature": pplan.signature,
+        "packed_device_path": ("pallas_cooc_int8_mxu" if kernel
+                               else "gram_einsum"),
+        "packed": {
+            "packed_rows_per_sec": {
+                "value": round(med_p, 1), "unit": "rows/sec/chip",
+                "value_canary_clean": round(med_p, 1) if clean else None},
+            "unpacked_rows_per_sec": {
+                "value": round(med_u, 1), "unit": "rows/sec/chip",
+                "value_canary_clean": round(med_u, 1) if clean else None},
+            # both sides share the rig: contention divides out, so the
+            # ratio is comparable even on canary-flagged rigs — no
+            # canary fields on purpose (the sentinel compares it raw)
+            "pack_speedup": {"value": round(med_p / med_u, 2),
+                             "unit": "x"},
+        },
+        "pack_cost_model": {
+            "wp": wp, "unpacked_cells": cells,
+            "width_slack": pallas_hist.WIDTH_SLACK,
+            "packs": wp <= pallas_hist.WIDTH_SLACK * cells},
+        "byte_identical": True,
+    }))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,450 @@
+"""PackGraft (round 16): block-diagonal gram packing.
+
+The exact einsum gram (``pallas_hist.gram_counts_cols``) must be
+bit-identical to the attested kernel in EVERY plan mode under the full
+drop-invalid contract; the planners (`pack_tables`/`pack_disjoint`) must
+gate on the width cost model and band alignment; a packed ChunkFolder
+must reproduce the unpacked fold byte-for-byte (moments included), carry
+packed-provenance g_keys across every reshard seam (kill-packed →
+resume-unpacked refuses or reshards, never silently folds), stream with
+ZERO steady-state recompiles through ragged tails, and keep GraftProf on
+the AOT path (a packed chunk never degrades to ``source:"shapes"``).
+Tree-side: ``level_packed="on"`` must grow byte-identical trees.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+from avenir_tpu.checkpoint import reshard
+from avenir_tpu.core.encoding import EncodedDataset
+from avenir_tpu.ops import agg, pallas_hist
+from avenir_tpu.pipeline import scan
+
+
+N, F, B, C, FC = 900, 5, 6, 2, 2
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(16)
+    codes = rng.integers(0, B, size=(N, F)).astype(np.int32)
+    # 1/16-grid values: f32 partial sums exact, so moment byte-identity
+    # is mathematics, not rounding luck (docs/streaming.md)
+    cont = (rng.integers(0, 16, size=(N, FC)) / 16.0).astype(np.float32)
+    labels = rng.integers(0, C, size=N).astype(np.int32)
+    return codes, cont, labels
+
+
+def mk_ds(data):
+    codes, cont, labels = data
+    return EncodedDataset(
+        codes=codes, cont=cont, labels=labels,
+        n_bins=np.full(F, B, np.int32), class_values=["a", "b"],
+        binned_ordinals=list(range(F)),
+        cont_ordinals=list(range(F, F + FC)))
+
+
+# ---------------------------------------------------------------------------
+# gram_counts_cols == kernel, every plan mode, full drop-invalid contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("f,b,c", [
+    (4, 5, 2),      # fmaj
+    (3, 11, 3),     # jmaj
+    (20, 20, 2),    # cls
+    (100, 20, 2),   # clsb (banded)
+])
+def test_gram_matches_kernel_every_mode(f, b, c):
+    mode, _, _ = pallas_hist.plan(f, b, c)
+    rng = np.random.default_rng(f * 100 + b)
+    n = 700
+    # out-of-range codes (negative AND ≥ B) drop per-feature; out-of-range
+    # labels drop the whole row — seeded deliberately, not left to chance
+    codes = rng.integers(-2, b + 2, size=(f, n)).astype(np.int32)
+    labels = rng.integers(-1, c + 1, size=n).astype(np.int32)
+    want = np.asarray(pallas_hist.cooc_counts_cols.__wrapped__(
+        codes, labels, b, c, interpret=True))
+    got = np.asarray(pallas_hist.gram_counts_cols.__wrapped__(
+        codes, labels, b, c, block_rows=256))   # force multi-block scan
+    np.testing.assert_array_equal(got, want, err_msg=f"mode {mode}")
+    # n == 0 must come back all-zero at the planned shape
+    empty = np.asarray(pallas_hist.gram_counts_cols.__wrapped__(
+        codes[:, :0], labels[:0], b, c))
+    assert empty.shape == want.shape and not empty.any()
+
+
+def test_gram_row_major_wrapper_and_moments(data):
+    codes, cont, labels = data
+    g1 = np.asarray(pallas_hist.gram_counts(codes, labels, B, C))
+    g2 = np.asarray(pallas_hist.gram_counts_cols.__wrapped__(
+        codes.T, labels, B, C))
+    np.testing.assert_array_equal(g1, g2)
+    g3, cnt, s1, s2 = pallas_hist.gram_counts_moments(
+        codes, labels, cont, B, C)
+    np.testing.assert_array_equal(np.asarray(g3), g1)
+    wcnt, ws1, ws2 = agg.class_moments(cont, labels, C)
+    np.testing.assert_array_equal(np.asarray(cnt), np.asarray(wcnt))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(ws1))
+    np.testing.assert_array_equal(np.asarray(s2), np.asarray(ws2))
+
+
+# ---------------------------------------------------------------------------
+# planners: cost gate, width cap, band alignment, key algebra
+# ---------------------------------------------------------------------------
+
+def test_pack_tables_gates_and_descriptor():
+    # hosp shape, all-pairs MI: packs onto the flagship W=384 plan
+    p = pallas_hist.pack_tables(11, 12, 2, 55)
+    assert p is not None and not p.disjoint
+    assert (p.num_feat, p.num_bins, p.num_classes) == (11, 12, 2)
+    assert len(p.members) == 11
+    assert p.g_key == f"g:packed:{p.mode}:f11:b12:c2"
+    assert p.g_key == pallas_hist.packed_g_key(11, 12, 2)
+    assert p.signature.startswith(f"{p.mode}:x11:")
+    # member offsets are the w_index block starts, strictly increasing
+    offs = [m.offset for m in p.members]
+    assert offs == sorted(offs) and offs[0] == 0
+    # NB-only (no pairs): wp dwarfs F·B unpacked cells → refuse
+    assert pallas_hist.pack_tables(11, 12, 2, 0) is None
+    # explicit width cap refuses a plan that would otherwise pack
+    assert pallas_hist.pack_tables(11, 12, 2, 55, max_width=128) is None
+    # degenerate shapes never pack
+    assert pallas_hist.pack_tables(0, 12, 2, 3) is None
+
+
+def test_pack_disjoint_band_alignment():
+    # a member count whose joint shape lands on clsb must stripe on
+    # whole bands: stripe_bins is a multiple of band_bins, every member
+    # offset a multiple of the stripe (no member straddles a band)
+    p = pallas_hist.pack_disjoint(8, 11, 24, 2)
+    assert p is not None and p.disjoint and p.mode == "clsb"
+    assert p.stripe_bins >= 24                 # rounded UP to whole bands
+    assert p.band_bins > 0 and p.stripe_bins % p.band_bins == 0
+    assert p.num_bins == 8 * p.stripe_bins
+    assert [m.offset for m in p.members] == \
+        [i * p.stripe_bins for i in range(8)]
+    assert pallas_hist.pack_disjoint(0, 11, 24, 2) is None
+    # joint width past every tier → refuse rather than mis-plan
+    assert pallas_hist.pack_disjoint(8, 11, 96, 2) is None
+    assert pallas_hist.pack_disjoint(64, 100, 500, 2) is None
+
+
+def test_packed_codes_stripe_bleed_and_member_drop():
+    # an out-of-range LOCAL code must become −1, never bleed into the
+    # neighboring member's stripe; member −1 drops the whole row
+    codes_t = np.array([[0, 4, 5, -3, 2]], np.int32)      # member_bins=5
+    member = np.array([0, 1, 1, 0, -1], np.int32)
+    out = np.asarray(pallas_hist.packed_codes(codes_t, member, 8, 5))
+    np.testing.assert_array_equal(out, [[0, 12, -1, -1, -1]])
+
+
+def test_packed_diag_index_reads_member_tables():
+    rng = np.random.default_rng(3)
+    f, b, c, m = 3, 4, 2, 4
+    p = pallas_hist.pack_disjoint(m, f, b, c)
+    assert p is not None
+    n = 600
+    codes_t = rng.integers(0, b, size=(f, n)).astype(np.int32)
+    member = rng.integers(0, m, size=n).astype(np.int32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    comp = pallas_hist.packed_codes(codes_t, member, p.stripe_bins, b)
+    g = np.asarray(pallas_hist.gram_counts_cols.__wrapped__(
+        comp, labels, p.num_bins, c))
+    wi = pallas_hist.packed_diag_index(p)                 # [F, B, M, C]
+    if g.ndim == 3:
+        w2 = wi[..., 0]
+        table = np.moveaxis(
+            np.stack([g[k][w2, w2] for k in range(c)]), 0, -1)
+    else:
+        table = g[wi, wi]
+    # oracle: per-member einsum over exactly that member's rows
+    for mm in range(m):
+        sel = member == mm
+        want = np.asarray(agg.feature_class_counts(
+            codes_t.T[sel], labels[sel], c, b))
+        np.testing.assert_array_equal(table[:, :, mm, :], want)
+
+
+# ---------------------------------------------------------------------------
+# ChunkFolder: packed fold == unpacked fold, byte for byte
+# ---------------------------------------------------------------------------
+
+def _engine(pack_on, **kw):
+    eng = scan.SharedScan(pack_on=pack_on, **kw)
+    eng.register(scan.NaiveBayesConsumer(name="nb"))
+    eng.register(scan.MutualInfoConsumer(name="mi"))
+    eng.register(scan.CorrelationConsumer(name="cramer", against_class=True))
+    return eng
+
+
+def _chunks(data, size=280):
+    ds = mk_ds(data)
+    return iter([ds.slice(i, min(i + size, N)) for i in range(0, N, size)])
+
+
+def test_packed_scan_byte_identical_to_unpacked(data):
+    packed = _engine(pack_on=True)
+    out_p = packed.run(_chunks(data))
+    assert packed.count_path.startswith("packed:")
+    out_u = _engine(pack_on=False).run(_chunks(data))
+    np.testing.assert_array_equal(out_p["nb"].bin_counts,
+                                  out_u["nb"].bin_counts)
+    np.testing.assert_array_equal(out_p["nb"].cont_sum, out_u["nb"].cont_sum)
+    np.testing.assert_array_equal(out_p["nb"].cont_sumsq,
+                                  out_u["nb"].cont_sumsq)
+    np.testing.assert_array_equal(out_p["mi"].pair_class_counts,
+                                  out_u["mi"].pair_class_counts)
+    assert out_p["mi"].to_lines() == out_u["mi"].to_lines()
+    np.testing.assert_array_equal(out_p["cramer"].contingency,
+                                  out_u["cramer"].contingency)
+
+
+def test_pack_max_width_pins_unpacked_routing(data):
+    folder = scan.ChunkFolder([scan.MutualInfoConsumer(name="mi")],
+                              mk_ds(data), pack_max_width=64)
+    assert folder.step == "einsum" and folder.pack is None
+    packed = scan.ChunkFolder([scan.MutualInfoConsumer(name="mi")],
+                              mk_ds(data))
+    assert packed.step == "packed"
+    assert packed.gk == pallas_hist.packed_g_key(F, B, C)
+    assert packed.program_tag == f"packed:{packed.pack.signature}"
+
+
+def test_cost_probe_packed_never_degrades_to_shapes(data):
+    """A packed chunk's ONE program IS the pass — GraftProf must get a
+    lowerable (AOT cost path), never fall to ``source:"shapes"``."""
+    ds = mk_ds(data)
+    folder = scan.ChunkFolder(
+        [scan.NaiveBayesConsumer(name="nb"),
+         scan.MutualInfoConsumer(name="mi")], ds)
+    assert folder.step == "packed"
+    probe = folder.cost_probe(ds)
+    assert probe is not None
+    lowerable, args = probe
+    assert lowerable is pallas_hist.gram_counts_moments
+    # and it actually lowers AOT over the chunk's own operands
+    import jax
+    jax.jit(lowerable.__wrapped__, static_argnames=(
+        "num_bins", "num_classes")).lower(*args)
+    # without continuous features the gram-only program is probed
+    ds2 = mk_ds(data)
+    ds2 = EncodedDataset(
+        codes=ds2.codes, cont=np.zeros((N, 0), np.float32),
+        labels=ds2.labels, n_bins=ds2.n_bins,
+        class_values=ds2.class_values, binned_ordinals=ds2.binned_ordinals,
+        cont_ordinals=[])
+    f2 = scan.ChunkFolder([scan.MutualInfoConsumer(name="mi")], ds2)
+    assert f2.step == "packed"
+    assert f2.cost_probe(ds2)[0] is pallas_hist.gram_counts
+
+
+# ---------------------------------------------------------------------------
+# reshard seams: packed provenance crosses or refuses, never silently folds
+# ---------------------------------------------------------------------------
+
+def _fold_state(data, pack_on):
+    ds = mk_ds(data)
+    folder = scan.ChunkFolder(
+        [scan.NaiveBayesConsumer(name="nb"),
+         scan.MutualInfoConsumer(name="mi")], ds, pack_on=pack_on)
+    acc = agg.Accumulator()
+    folder.fold(ds, acc)
+    return folder, acc.state()
+
+
+def _tables(folder, state):
+    acc = agg.Accumulator()
+    acc.load(state)
+    return folder.tables(acc, N)
+
+
+def test_adopt_packed_state_onto_einsum_demotes_exactly(data):
+    fp, state_p = _fold_state(data, pack_on=True)
+    assert fp.step == "packed" and fp.gk.startswith("g:packed:")
+    fu, state_u = _fold_state(data, pack_on=False)
+    assert fu.step == "einsum"
+    adopted, moved = fu.adopt_state(state_p)
+    assert moved == [fp.gk]
+    t_demoted = _tables(fu, adopted)
+    t_oracle = _tables(fu, state_u)
+    np.testing.assert_array_equal(t_demoted.fbc, t_oracle.fbc)
+    np.testing.assert_array_equal(t_demoted.pcc, t_oracle.pcc)
+
+
+def test_adopt_kernel_state_onto_packed_normalizes_base(data):
+    """Kill-unpacked → resume-packed: the kernel base renames onto the
+    packed base (identical G bytes for one (F, B, C)) — and the reverse
+    crossing demotes (covered above); NEITHER silently mixes keys."""
+    fp, state_p = _fold_state(data, pack_on=True)
+    # fabricate kernel-provenance state with the SAME bytes (the packed
+    # and kernel bases share w_index layout by construction)
+    kernel_key = pallas_hist.g_key(F, B, C)
+    state_k = {(kernel_key if k == fp.gk else k): v
+               for k, v in state_p.items()}
+    assert not fp.state_matches_routing(state_k)
+    adopted, moved = fp.adopt_state(state_k)
+    assert moved == [kernel_key]
+    assert fp.state_matches_routing(adopted)
+    t = _tables(fp, adopted)
+    t_own = _tables(fp, state_p)
+    np.testing.assert_array_equal(t.fbc, t_own.fbc)
+    np.testing.assert_array_equal(t.pcc, t_own.pcc)
+
+
+def test_adopt_refuses_mixed_provenance_and_foreign_layout(data):
+    fp, state_p = _fold_state(data, pack_on=True)
+    kernel_key = pallas_hist.g_key(F, B, C)
+    with pytest.raises(reshard.ReshardError, match="mixed kernel/packed"):
+        fp.adopt_state({**state_p, kernel_key: state_p[fp.gk]})
+    foreign = {"g:packed:fmaj:f9:b9:c9": np.zeros((2, 2), np.int64),
+               "class": state_p["class"]}
+    with pytest.raises(reshard.ReshardError, match="base layout"):
+        fp.adopt_state(foreign)
+    # einsum counts promoted onto the packed gram routing: pairs outside
+    # the persisted union were never aggregated → refuse
+    _, state_u = _fold_state(data, pack_on=False)
+    with pytest.raises(reshard.ReshardError, match="promotion is impossible"):
+        fp.adopt_state(state_u)
+
+
+def test_tables_refuses_foreign_packed_key(data):
+    fu, state_u = _fold_state(data, pack_on=False)
+    state_u = dict(state_u)
+    state_u[pallas_hist.packed_g_key(F, B, C)] = np.zeros((2, 2), np.int64)
+    with pytest.raises(scan.ScanError, match="gram state"):
+        _tables(fu, state_u)
+
+
+# ---------------------------------------------------------------------------
+# streaming: packed panes warm AOT and never recompile on ragged tails
+# ---------------------------------------------------------------------------
+
+def _stream_fixture(tmp_path):
+    import json as _json
+
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+
+    fields = [{"name": "id", "ordinal": 0, "id": True,
+               "dataType": "string"}]
+    for j in range(F):
+        fields.append({"name": f"f{j}", "ordinal": 1 + j, "feature": True,
+                       "dataType": "categorical",
+                       "cardinality": [str(v) for v in range(B)]})
+    fields.append({"name": "cls", "ordinal": 1 + F,
+                   "dataType": "categorical", "cardinality": ["a", "b"]})
+    (tmp_path / "s.json").write_text(_json.dumps({"fields": fields}))
+    enc = DatasetEncoder(FeatureSchema.from_file(str(tmp_path / "s.json")))
+    rng = np.random.default_rng(8)
+    lines = [",".join([f"r{i}"]
+                      + [str(int(v)) for v in rng.integers(0, B, F)]
+                      + [["a", "b"][int(rng.integers(0, 2))]])
+             for i in range(100)]
+    return enc, lines
+
+
+def test_packed_stream_zero_recompiles_with_ragged_tail(tmp_path):
+    from avenir_tpu.stream import WindowedScan
+
+    enc, lines = _stream_fixture(tmp_path)
+    ws = WindowedScan(enc, [scan.NaiveBayesConsumer(name="nb"),
+                            scan.MutualInfoConsumer(name="mi")],
+                      pane_rows=32, window_panes=1)
+    assert ws.folder.step == "packed"
+    ws.warm()
+    ws.feed(lines)                       # 3 full panes + 4-row ragged tail
+    ws.flush()
+    assert not ws.counters.get("Stream", "recompiles"), \
+        "packed pane folds must hit pre-warmed pow-2 shapes"
+    # and the packed stream equals the pack_on=False stream byte-for-byte
+    ws_u = WindowedScan(enc, [scan.NaiveBayesConsumer(name="nb"),
+                              scan.MutualInfoConsumer(name="mi")],
+                        pane_rows=32, window_panes=1, pack_on=False)
+    assert ws_u.folder.step == "einsum"
+    wp = WindowedScan(enc, [scan.NaiveBayesConsumer(name="nb"),
+                            scan.MutualInfoConsumer(name="mi")],
+                      pane_rows=32, window_panes=1)
+    for a, b in zip(wp.feed(lines) + wp.flush(),
+                    ws_u.feed(lines) + ws_u.flush()):
+        np.testing.assert_array_equal(a.results["nb"].bin_counts,
+                                      b.results["nb"].bin_counts)
+        np.testing.assert_array_equal(a.results["mi"].pair_class_counts,
+                                      b.results["mi"].pair_class_counts)
+
+
+# ---------------------------------------------------------------------------
+# trees: level_packed="on" grows byte-identical trees
+# ---------------------------------------------------------------------------
+
+def test_tree_level_packed_byte_identical():
+    from avenir_tpu.datagen.retarget import (RETARGET_SCHEMA_JSON,
+                                             generate_retarget)
+    from avenir_tpu.core.encoding import DatasetEncoder
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.models import tree as dtree
+
+    schema = FeatureSchema.from_json(RETARGET_SCHEMA_JSON)
+    ds = DatasetEncoder(schema).fit_transform(generate_retarget(3000,
+                                                                seed=9))
+    is_cat = [f.is_categorical for f in schema.binned_feature_fields]
+    for hist_mode in ("direct", "subtract"):
+        kw = dict(algorithm="entropy", max_depth=3, split_search="binary",
+                  min_node_size=64, hist_mode=hist_mode)
+        off = dtree.DecisionTree(level_packed="off", **kw).fit(ds, is_cat)
+        on = dtree.DecisionTree(level_packed="on", **kw).fit(ds, is_cat)
+        assert on.to_string() == off.to_string(), hist_mode
+    with pytest.raises(ValueError, match="level_packed"):
+        dtree.DecisionTree(level_packed="sometimes")
+
+
+# ---------------------------------------------------------------------------
+# sentinel: packed rows compare when present, skip-optional when absent
+# ---------------------------------------------------------------------------
+
+def test_sentinel_packed_rows_and_optional_bands():
+    from avenir_tpu.telemetry import sentinel
+
+    packed_line = {
+        "metric": "nb_mi_wide_schema_throughput", "value": 9.0e6,
+        "unit": "rows/sec/chip", "value_canary_clean": 9.0e6,
+        "packed": {
+            "packed_rows_per_sec": {"value": 9.0e6, "unit": "rows/sec/chip",
+                                    "value_canary_clean": 9.0e6},
+            "unpacked_rows_per_sec": {"value": 1.2e6,
+                                      "unit": "rows/sec/chip",
+                                      "value_canary_clean": 1.2e6},
+            "pack_speedup": {"value": 7.2, "unit": "x"},
+        },
+    }
+    m = sentinel.extract_metrics(packed_line)
+    assert m["packed.pack_speedup"]["value"] == 7.2
+    assert not m["packed.pack_speedup"]["canary_flagged"]
+    assert m["packed.packed_rows_per_sec"]["value"] == 9.0e6
+
+    baseline = {**packed_line, "sentinel": {"optional": ["packed.*"]}}
+    # a capture from a benchmark that never emits packed rows (bench.py's
+    # primary line) must NOT fail the gate — skipped_optional, not missing
+    other = {"metric": "nb_mi_wide_schema_throughput", "value": 9.2e6,
+             "unit": "rows/sec/chip", "value_canary_clean": 9.2e6}
+    summary = sentinel.evaluate(other, baseline)
+    assert summary["verdict"] == "pass" and not summary["missing"]
+    assert set(summary["skipped"]) == {"packed.pack_speedup",
+                                       "packed.packed_rows_per_sec",
+                                       "packed.unpacked_rows_per_sec"}
+    # but a PRESENT packed row is still compared — and can regress
+    slow = {**packed_line,
+            "packed": {**packed_line["packed"],
+                       "pack_speedup": {"value": 1.0, "unit": "x"}}}
+    summary = sentinel.evaluate(slow, baseline)
+    assert "packed.pack_speedup" in summary["regressed"]
+    # canary-flagged packed throughput rows skip instead of comparing
+    flagged = {**packed_line,
+               "packed": {**packed_line["packed"],
+                          "packed_rows_per_sec": {
+                              "value": 9.0e6, "unit": "rows/sec/chip",
+                              "value_canary_clean": None}}}
+    summary = sentinel.evaluate(flagged, baseline)
+    assert "packed.packed_rows_per_sec" in summary["skipped"]
+    assert summary["verdict"] == "pass"

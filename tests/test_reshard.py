@@ -164,12 +164,17 @@ def test_reshard_state_tree_walks_rings_and_acc():
 # ChunkFolder.adopt_state: refuse OR reshard, never silently fold
 # ---------------------------------------------------------------------------
 
-def _fold_state(data, shard=None):
-    """One chunk folded under a topology → (folder, state mapping)."""
+def _fold_state(data, shard=None, pack_on=True):
+    """One chunk folded under a topology → (folder, state mapping).
+    ``pack_on=False`` pins the chunked-einsum routing for the drills
+    that exercise demotion/promotion explicitly (PackGraft would
+    otherwise pack this NB+MI shape onto the wide-gram dispatch —
+    the packed drills live in tests/test_pack.py)."""
     ds = mk_ds(data)
     folder = scan.ChunkFolder(
         [scan.NaiveBayesConsumer(name="nb"),
-         scan.MutualInfoConsumer(name="mi")], ds, shard=shard)
+         scan.MutualInfoConsumer(name="mi")], ds, shard=shard,
+        pack_on=pack_on)
     acc = agg.Accumulator()
     folder.fold(ds, acc)
     return folder, acc.state()
@@ -238,7 +243,7 @@ def test_adopt_state_demotes_gram_onto_einsum_routing(data):
     1-chip CPU path) is DEMOTED through counts_from_cooc — the identical
     read-out tables() runs — so the resumed tables stay byte-identical."""
     f8, state8 = _fold_state(data, spec_for(8))
-    plain, plain_state = _fold_state(data)          # einsum on CPU
+    plain, plain_state = _fold_state(data, pack_on=False)   # einsum on CPU
     assert plain.step == "einsum"
     adopted, moved = plain.adopt_state(state8)
     assert moved == [f8.gk]
@@ -258,7 +263,7 @@ def test_adopt_state_demotes_gram_onto_einsum_routing(data):
 
 def test_adopt_state_refusals(data):
     f8, state8 = _fold_state(data, spec_for(8))
-    _, plain_state = _fold_state(data)
+    _, plain_state = _fold_state(data, pack_on=False)
     # einsum counts cannot be PROMOTED onto a gram routing
     with pytest.raises(reshard.ReshardError, match="promotion"):
         f8.adopt_state(plain_state)
@@ -297,10 +302,12 @@ def _consumers():
             scan.MutualInfoConsumer(name="mi")]
 
 
-def _windowed(enc, shard=None, checkpointer=None, fault=None):
+def _windowed(enc, shard=None, checkpointer=None, fault=None,
+              pack_on=True):
     return WindowedScan(enc, _consumers(), pane_rows=128, window_panes=2,
                         slide_panes=1, shard=shard,
-                        checkpointer=checkpointer, fault=fault)
+                        checkpointer=checkpointer, fault=fault,
+                        pack_on=pack_on)
 
 
 @pytest.fixture(scope="module")
@@ -322,7 +329,8 @@ def drill(data, tmp_path_factory):
     return {"enc": enc, "lines": lines, "oracle": oracle, "ring": ring}
 
 
-def _resume_and_compare(drill, tmp_path, shard=None, min_compared=1):
+def _resume_and_compare(drill, tmp_path, shard=None, min_compared=1,
+                        pack_on=True):
     """Copy the killed ring, resume under ``shard`` with the gate ON,
     and assert every post-resume window byte-identical to the unkilled
     unsharded oracle's."""
@@ -330,7 +338,8 @@ def _resume_and_compare(drill, tmp_path, shard=None, min_compared=1):
     shutil.copytree(drill["ring"], ring)
     ck = WindowCheckpointer(str(ring), run_id="drill", interval_panes=2,
                             resume=True, reshard=True)
-    ws = _windowed(drill["enc"], shard=shard, checkpointer=ck)
+    ws = _windowed(drill["enc"], shard=shard, checkpointer=ck,
+                   pack_on=pack_on)
     skip = ck.restore_into(ws)
     assert 0 < skip < len(drill["lines"])
     resumed = ws.feed(drill["lines"][skip:])
@@ -407,7 +416,7 @@ def test_elastic_restore_onto_unsharded_einsum(drill, tmp_path):
     """Kill on 8, resume UNSHARDED (the CPU einsum routing): the gram is
     demoted through adopt_state and the stream still reproduces the
     oracle's windows byte-for-byte — the full shrink-to-one-chip case."""
-    ws1 = _resume_and_compare(drill, tmp_path, shard=None)
+    ws1 = _resume_and_compare(drill, tmp_path, shard=None, pack_on=False)
     assert ws1.folder.step == "einsum"
 
 
@@ -464,7 +473,7 @@ def test_einsum_snapshot_onto_gram_routing_never_silently_folds(
         drill["enc"],
         checkpointer=WindowCheckpointer(str(ring), run_id="drill",
                                         interval_panes=2),
-        fault=FaultPlan({"fold": 5}))
+        fault=FaultPlan({"fold": 5}), pack_on=False)
     assert ws1.folder.step == "einsum"
     with pytest.raises(InjectedFault):
         ws1.feed(drill["lines"])
@@ -477,6 +486,14 @@ def test_einsum_snapshot_onto_gram_routing_never_silently_folds(
         with pytest.raises(ConfigError,
                            match="einsum.*cannot be promoted"):
             ck.restore_into(_windowed(drill["enc"], shard=spec_for(8)))
+    # the packed gram routing refuses promotion identically: pair
+    # tensors outside the einsum snapshot's union were never aggregated
+    ck = WindowCheckpointer(str(ring), run_id="drill", interval_panes=2,
+                            resume=True, reshard=True)
+    ws_packed = _windowed(drill["enc"])
+    assert ws_packed.folder.step == "packed"
+    with pytest.raises(ConfigError, match="einsum.*cannot be promoted"):
+        ck.restore_into(ws_packed)
 
 
 # ---------------------------------------------------------------------------
