@@ -1,4 +1,5 @@
 from avenir_tpu.pipeline.driver import Pipeline, Stage, decision_tree_pipeline, knn_pipeline
+from avenir_tpu.pipeline.plan import PipelinePlan, plan_pipeline
 from avenir_tpu.pipeline.streaming import (
     InProcQueue,
     QueueActionWriter,
@@ -10,6 +11,8 @@ from avenir_tpu.pipeline.streaming import (
 __all__ = [
     "InProcQueue",
     "Pipeline",
+    "PipelinePlan",
+    "plan_pipeline",
     "QueueActionWriter",
     "QueueRewardReader",
     "QueueEventSource",

@@ -65,6 +65,46 @@ class Pipeline:
         self.counters: Dict[str, Counters] = {}
         os.makedirs(workspace, exist_ok=True)
 
+    @classmethod
+    def from_conf(cls, conf: JobConfig,
+                  workspace: Optional[str] = None) -> "Pipeline":
+        """The conf-declared pipeline DAG — what the shell runbooks staged
+        by hand, as properties the planner (``pipeline/plan.py``) and the
+        ``python -m avenir_tpu.pipeline`` CLI can load whole:
+
+        - ``pipeline.workspace`` — artifact directory (or pass it here);
+        - ``pipeline.stages`` — stage names, comma-separated, in order;
+        - ``pipeline.stage.<name>.job`` / ``.input`` / ``.output`` /
+          ``.uses`` (comma list) / ``.prop.<key>`` (per-stage override,
+          ``@artifact`` references resolve like :class:`Stage` props);
+        - ``pipeline.bind.<artifact>`` — external path bindings."""
+        names = conf.get_list("pipeline.stages")
+        if not names:
+            raise ValueError(
+                "pipeline.stages must list the stage names in execution "
+                "order (see docs/jobs.md, 'Conf-declared pipelines')")
+        ws = workspace or conf.get("pipeline.workspace") or "pipeline_ws"
+        p = cls(ws, conf)
+        bind_pref = "pipeline.bind."
+        for key in sorted(conf.props):
+            if key.startswith(bind_pref):
+                p.bind(key[len(bind_pref):], conf.props[key])
+        for name in names:
+            pref = f"pipeline.stage.{name}."
+            job = conf.get(pref + "job")
+            inp = conf.get(pref + "input")
+            out = conf.get(pref + "output")
+            if not (job and inp and out):
+                raise ValueError(
+                    f"stage {name!r} needs {pref}job, {pref}input and "
+                    f"{pref}output")
+            prop_pref = pref + "prop."
+            props = {k[len(prop_pref):]: v for k, v in conf.props.items()
+                     if k.startswith(prop_pref)}
+            p.add(Stage(name, job, inp, out, props=props,
+                        uses=tuple(conf.get_list(pref + "uses") or ())))
+        return p
+
     def add(self, stage: Stage) -> "Pipeline":
         self.stages.append(stage)
         return self
@@ -218,7 +258,19 @@ class Pipeline:
             from avenir_tpu.parallel.shard import ShardSpec
 
             ShardSpec.from_conf(self.conf)
-            self._run_stages(todo, resume, tracer)
+            if self.conf.get_bool("plan.on", False):
+                # PlanGraft: lower the declared DAG into plan units (non-
+                # adjacent fusion, share-gram, dead-column pruning, AOT-
+                # costed pack selection) and execute the plan — byte-
+                # identical artifacts to the staged loop below, which
+                # remains the default and the oracle (tests/test_plan.py)
+                from avenir_tpu.pipeline import plan as plan_mod
+
+                pl = plan_mod.plan_pipeline(self, todo, resume=resume)
+                plan_mod.journal_plan(pl.summary(), tracer)
+                plan_mod.run_plan(self, pl, tracer)
+            else:
+                self._run_stages(todo, resume, tracer)
             tracer.counters("pipeline", self.rollup())
         # fused-scan samples never pass through Job.run — flush them here
         # so the run journal's program totals are complete at pipeline end
@@ -227,21 +279,70 @@ class Pipeline:
         _profile.profiler().flush()
         return self.counters
 
+    def _mark_skipped(self, stage: Stage, tracer) -> None:
+        """A resume-satisfied stage must still appear in the run report
+        (and the journal): an absent entry is indistinguishable from a
+        stage the DAG never declared.  Mark IN PLACE when the stage
+        already has counters (a partial run resumed on the same Pipeline
+        object) — replacing them would throw away the real counts the
+        earlier execution collected."""
+        marked = self.counters.setdefault(stage.name, Counters())
+        marked.set("Pipeline", "skipped", 1)
+        tracer.event("stage.skipped", stage=stage.name,
+                     output=self.path(stage.output))
+
+    def _run_single(self, stage: Stage, conf: JobConfig, tracer) -> None:
+        """One stage on its own job path — the staged loop's per-stage
+        body, shared with the planner's fallback units."""
+        out = self.path(stage.output)
+        attrs = {"job": (stage.job if isinstance(stage.job, str)
+                         else getattr(stage.job, "__name__", "callable")),
+                 "output": out}
+        from avenir_tpu.parallel.shard import ShardSpec
+
+        if ShardSpec.requested(conf):
+            # shard.* covers only the SharedScan fold (fused count
+            # stages, streaming); this stage runs its normal path —
+            # say so in the trace instead of implying parallelism
+            attrs["sharded"] = stage.job == "StreamAnalytics"
+        with tracer.span(f"stage.{stage.name}", attrs=attrs), \
+                self._xla_trace(stage.name, tracer):
+            self.counters[stage.name] = stage.run(
+                conf, self.path(stage.input), out)
+            tracer.counters(stage.name, self.counters[stage.name])
+
+    def _run_fused(self, group: List[Stage], gconfs: List[JobConfig],
+                   tracer, extra_attrs: Optional[dict] = None,
+                   **fused_kwargs) -> None:
+        """A stage group through ONE SharedScan — the staged loop's fused
+        branch, shared with the planner's scan units (which pass the
+        plan-node span attrs plus prune/pack/encode-cache decisions
+        through ``fused_kwargs``)."""
+        from avenir_tpu.pipeline import scan
+
+        attrs = {"stages": [s.name for s in group],
+                 "input": self.path(group[0].input)}
+        if extra_attrs:
+            attrs.update(extra_attrs)
+        with tracer.span("scan.fused", attrs=attrs) as sp, \
+                self._xla_trace(group[0].name, tracer):
+            fused = scan.run_fused_stages(
+                [(s.name, s.job, self.path(s.input),
+                  self.path(s.output), conf)
+                 for s, conf in zip(group, gconfs)], **fused_kwargs)
+            self.counters.update(fused)
+            first = fused[group[0].name]
+            sp.set("chunks", first.get("SharedScan", "Chunks"))
+            sp.set("rows", first.get("Records", "Processed"))
+            for s in group:
+                tracer.counters(s.name, fused[s.name])
+
     def _run_stages(self, todo: List[Stage], resume: bool, tracer) -> None:
         i = 0
         while i < len(todo):
             stage = todo[i]
-            out = self.path(stage.output)
-            if resume and os.path.exists(out):
-                # a satisfied stage must still appear in the run report
-                # (and the journal): an absent entry is indistinguishable
-                # from a stage the DAG never declared.  Mark IN PLACE when
-                # the stage already has counters (a partial run resumed on
-                # the same Pipeline object) — replacing them would throw
-                # away the real counts the earlier execution collected
-                marked = self.counters.setdefault(stage.name, Counters())
-                marked.set("Pipeline", "skipped", 1)
-                tracer.event("stage.skipped", stage=stage.name, output=out)
+            if resume and os.path.exists(self.path(stage.output)):
+                self._mark_skipped(stage, tracer)
                 i += 1
                 continue
             # stage fusion (round 7): consecutive count jobs reading the
@@ -250,41 +351,11 @@ class Pipeline:
             # (scan.fuse=false opts a stage or the whole pipeline out)
             group, gconfs, fuse = self._scan_group(todo, i, resume)
             if fuse:
-                from avenir_tpu.pipeline import scan
-
-                with tracer.span("scan.fused",
-                                 attrs={"stages": [s.name for s in group],
-                                        "input": self.path(group[0].input)}
-                                 ) as sp, \
-                        self._xla_trace(group[0].name, tracer):
-                    fused = scan.run_fused_stages(
-                        [(s.name, s.job, self.path(s.input),
-                          self.path(s.output), conf)
-                         for s, conf in zip(group, gconfs)])
-                    self.counters.update(fused)
-                    first = fused[group[0].name]
-                    sp.set("chunks", first.get("SharedScan", "Chunks"))
-                    sp.set("rows", first.get("Records", "Processed"))
-                    for s in group:
-                        tracer.counters(s.name, fused[s.name])
+                self._run_fused(group, gconfs, tracer)
                 i += len(group)
                 continue
             conf = gconfs[0] if gconfs else self._stage_conf(stage)
-            attrs = {"job": (stage.job if isinstance(stage.job, str)
-                             else getattr(stage.job, "__name__", "callable")),
-                     "output": out}
-            from avenir_tpu.parallel.shard import ShardSpec
-
-            if ShardSpec.requested(conf):
-                # shard.* covers only the SharedScan fold (fused count
-                # stages, streaming); this stage runs its normal path —
-                # say so in the trace instead of implying parallelism
-                attrs["sharded"] = stage.job == "StreamAnalytics"
-            with tracer.span(f"stage.{stage.name}", attrs=attrs), \
-                    self._xla_trace(stage.name, tracer):
-                self.counters[stage.name] = stage.run(
-                    conf, self.path(stage.input), out)
-                tracer.counters(stage.name, self.counters[stage.name])
+            self._run_single(stage, conf, tracer)
             i += 1
 
 

@@ -1,0 +1,747 @@
+"""PlanGraft — compile the conf-declared pipeline DAG into one device program.
+
+The driver executes a pipeline as a Python loop over stages with host hops
+between them; round 7's SharedScan fuses only *consecutive* count stages,
+and PackGraft (round 16) packs tables only within such a group.  This
+module treats the declared DAG as a query plan instead: :func:`plan_pipeline`
+lowers a whole train→select→score pipeline into an ordered list of plan
+units, where every fusable count stage over the same artifact — adjacent or
+not — rides ONE scan unit (one parse+encode+gram pass), and four rewrites
+fire per unit:
+
+- **fuse** — non-adjacent fusable stages over the same input collapse into
+  one scan unit (the driver's ``_scan_group`` stops at the first
+  non-fusable stage; the planner hoists past it when dependency-safe);
+- **share-gram** — a stage whose ``uses`` edge names another member's
+  output joins the same unit and reads the SAME gram (the edge is
+  ordering-only: fusable consumers are constructed from conf+schema, never
+  from a data artifact, and outputs are written at finalize in declared
+  order).  A ``@artifact`` property reference is a *value* dependency and
+  keeps the stage staged;
+- **prune** — dead binned columns (columns no member's output depends on)
+  are dropped from the fold; correlation statistics slice each pair to its
+  true ``n_bins`` support, so the narrower gram reproduces the same output
+  bytes;
+- **pack** — the PackGraft packed-vs-einsum choice is made at *plan* time:
+  both candidates are compiled ahead of time over a peeked sample chunk
+  (the PR-9 CompiledProgramRegistry's ``profile.aot_cost`` records their
+  estimates) and ONE measured dispatch of each picks the faster program,
+  instead of the runtime width heuristic alone;
+
+plus **encode-once**: scan units reading the same artifact under the same
+encode keys share one whole-input ``EncodedDataset`` through an encode
+cache (``scan.run_fused_stages``'s ``encode_cache`` seam).
+
+Checkpointed / multi-process / text-mode / opted-out stages fall back to
+staged execution (:class:`StageUnit`) with the refusal reason surfaced in
+``plan explain``, exactly as ``_scan_group`` fusion refuses them today;
+resume-satisfied stages are pruned from the plan (:class:`SkipUnit`) and
+journaled per stage without clobbering a partial run's counters.
+
+Byte-identity to the staged path is the oracle (tests/test_plan.py): a
+planned run's artifacts are bit-for-bit the staged run's, for every
+rewrite, on both the kernel and einsum routings.
+
+``python -m avenir_tpu.pipeline plan <conf>`` prints :meth:`PipelinePlan.
+explain` — the fused plan tree with per-node cost estimates and which
+rewrites fired.  ``plan.on=true`` routes ``Pipeline.run`` through
+:func:`run_plan` (default off).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.pipeline.driver import Pipeline, Stage
+
+REWRITES = ("fuse", "share-gram", "prune", "encode-once", "pack")
+
+
+@dataclass
+class SkipUnit:
+    """A resume-satisfied stage: pruned from the plan, journaled as
+    ``stage.skipped`` at execution without touching its counters."""
+
+    stage: Stage
+
+
+@dataclass
+class StageUnit:
+    """A stage the planner keeps on the staged path, and why."""
+
+    stage: Stage
+    conf: JobConfig
+    reason: str
+
+
+@dataclass
+class ScanUnit:
+    """One planned SharedScan serving one or more stages."""
+
+    stages: List[Stage]
+    confs: List[JobConfig]
+    input: str                              # artifact name
+    in_path: str
+    rewrites: List[str] = field(default_factory=list)
+    keep: Optional[List[int]] = None        # pruned binned positions
+    pruned_from: int = 0                    # full binned width
+    pack_on: Optional[bool] = None          # None = runtime heuristic
+    pack_max_width: Optional[int] = None
+    pack_source: str = ""                   # "measured" | "aot" | "model" | ""
+    cost: Optional[dict] = None             # AOT estimate over the sample
+    cost_rows: int = 0                      # sample rows the estimate covers
+    wall_ms: Optional[float] = None         # measured sample-chunk dispatch
+    program: str = ""                       # predicted routing tag
+    staged_scans: int = 1                   # scans the staged path would pay
+
+
+class PipelinePlan:
+    """The ordered unit list :func:`plan_pipeline` produced, with the
+    explain rendering and the ``plan.compiled`` journal summary."""
+
+    def __init__(self, pipeline: Pipeline, units: List[object],
+                 resume: bool):
+        self.pipeline = pipeline
+        self.units = units
+        self.resume = resume
+
+    @property
+    def scan_units(self) -> List[ScanUnit]:
+        return [u for u in self.units if isinstance(u, ScanUnit)]
+
+    def summary(self) -> dict:
+        """The ``plan.compiled`` event payload: unit/stage shape, which
+        rewrites fired anywhere, and the summed cost estimate (null when
+        the backend degraded to shapes-only)."""
+        scans = self.scan_units
+        stages = sum(len(u.stages) for u in scans) + sum(
+            1 for u in self.units if not isinstance(u, ScanUnit))
+        rewrites = sorted({r for u in scans for r in u.rewrites})
+
+        def total(key: str) -> Optional[float]:
+            vals = [u.cost.get(key) for u in scans if u.cost]
+            vals = [v for v in vals if v is not None]
+            return float(sum(vals)) if vals else None
+
+        ranks = {"measured": 3, "aot": 2, "model": 1}
+        best = max((ranks.get(u.pack_source, 0) for u in scans), default=0)
+        source = {3: "measured", 2: "aot", 1: "model", 0: "none"}[best]
+        return {"units": len(self.units), "stages": stages,
+                "fused": sum(len(u.stages) for u in scans),
+                "rewrites": rewrites, "source": source,
+                "est_flops": total("flops"),
+                "est_bytes": total("bytes_accessed")}
+
+    def explain(self) -> str:
+        """The fused plan tree: one node per unit, member stages beneath,
+        per-node cost estimates and the rewrites that fired."""
+        lines = []
+        scans = self.scan_units
+        lines.append(
+            f"PlanGraft: {sum(len(u.stages) for u in scans) + sum(1 for u in self.units if not isinstance(u, ScanUnit))}"
+            f" stage(s) -> {len(self.units)} unit(s)"
+            + (" [resume]" if self.resume else ""))
+        last = len(self.units) - 1
+        for k, unit in enumerate(self.units):
+            head = "`-" if k == last else "|-"
+            bar = "  " if k == last else "| "
+            if isinstance(unit, SkipUnit):
+                lines.append(f"{head} skip {unit.stage.name}: output exists"
+                             f" (resume)")
+                continue
+            if isinstance(unit, StageUnit):
+                job = (unit.stage.job if isinstance(unit.stage.job, str)
+                       else getattr(unit.stage.job, "__name__", "callable"))
+                lines.append(f"{head} stage {unit.stage.name}: job={job} -- "
+                             f"{unit.reason}")
+                continue
+            lines.append(
+                f"{head} scan unit: input={unit.input} serves "
+                f"{len(unit.stages)} stage(s) in 1 scan"
+                + (f" (staged path ~ {unit.staged_scans} scans)"
+                   if len(unit.stages) > 1 else ""))
+            if unit.rewrites:
+                lines.append(f"{bar}   rewrites: "
+                             + ", ".join(unit.rewrites))
+            if unit.keep is not None:
+                lines.append(f"{bar}   prune: {unit.pruned_from} -> "
+                             f"{len(unit.keep)} binned columns")
+            detail = f"{bar}   program: {unit.program or '?'}"
+            if unit.cost is not None:
+                detail += " -- est " + _fmt_cost(unit.cost, unit.cost_rows)
+                if unit.wall_ms is not None:
+                    detail += f", predicted {unit.wall_ms:.2f} ms/chunk"
+                detail += f" ({unit.pack_source or 'aot'})"
+            elif unit.pack_source:
+                detail += f" -- est unavailable ({unit.pack_source})"
+            lines.append(detail)
+            for m, s in enumerate(unit.stages):
+                sub = "`-" if m == len(unit.stages) - 1 else "|-"
+                lines.append(f"{bar}   {sub} {s.name} ({s.job}) -> "
+                             f"{s.output}")
+        return "\n".join(lines)
+
+
+def _fmt_cost(cost: dict, rows: int) -> str:
+    parts = []
+    if cost.get("flops") is not None:
+        parts.append(f"{cost['flops'] / 1e6:.3f} MFLOP")
+    if cost.get("bytes_accessed") is not None:
+        parts.append(f"{cost['bytes_accessed'] / 1e6:.3f} MB")
+    body = " / ".join(parts) if parts else "n/a"
+    return f"{body} per {rows}-row sample chunk"
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def _join_shares(pipeline: Pipeline, cand: Stage, producers: Dict[str, Stage],
+                 taken: set, member_names: set, member_outs: set,
+                 stages: List[Stage], i: int, j: int, in_path: str
+                 ) -> Optional[List[str]]:
+    """Can ``cand`` (position ``j``) join the unit anchored at ``i``?
+    Returns the member outputs it reaches via ``uses`` (share-gram edges),
+    or None when joining would reorder a real dependency:
+
+    - a ``@artifact`` property naming a member output is a *value*
+      dependency — the stage reads the file's contents, which do not exist
+      until the unit finalizes;
+    - any dependency produced by a stage not yet scheduled (it would run
+      AFTER this unit) refuses the hoist;
+    - an unclaimed stage between the anchor and the candidate that rewrites
+      the shared input (or the candidate's own output) would observe a
+      different file under the hoisted order."""
+    shares: List[str] = []
+    prop_arts = [v[1:] for v in cand.props.values()
+                 if isinstance(v, str) and v.startswith("@")]
+    for art in prop_arts:
+        if art in member_outs:
+            return None
+        prod = producers.get(art)
+        if prod is not None and prod.name not in taken \
+                and prod.name not in member_names:
+            return None
+    for art in cand.uses:
+        if art in member_outs:
+            shares.append(art)
+            continue
+        prod = producers.get(art)
+        if prod is not None and prod.name not in taken \
+                and prod.name not in member_names:
+            return None
+    for k in range(i + 1, j):
+        mid = stages[k]
+        if mid.name in taken or mid.name in member_names:
+            continue
+        if pipeline.path(mid.output) == in_path \
+                or mid.output == cand.output:
+            return None
+    return shares
+
+
+def _peek_sample(conf: JobConfig, in_path: str, rows: int):
+    """``(EncodedDataset, estimated total rows)`` from the head of
+    ``in_path`` — shape-true metadata for cost estimation, plus a
+    bytes-per-row extrapolation of the file's row count (the wall model
+    evaluates candidates at the ACTUAL chunk size, not the sample's).
+    None when the input does not exist yet (an artifact a prior stage
+    will produce) or cannot be parsed; the plan then records
+    model-derived estimates only."""
+    from avenir_tpu.jobs.base import Job
+
+    if rows <= 0 or not in_path or not os.path.isfile(in_path):
+        return None
+    enc = Job.encoder_for(conf)
+    delim = conf.field_delim_regex
+    parsed: List[List[str]] = []
+    consumed = 0
+    try:
+        with open(in_path, "r", errors="replace") as fh:
+            for line in fh:
+                consumed += len(line)
+                line = line.rstrip("\n")
+                if not line.strip():
+                    continue
+                parsed.append(re.split(delim, line))
+                if len(parsed) >= rows:
+                    break
+    except OSError:
+        return None
+    ncols = enc.max_ordinal()
+    parsed = [r for r in parsed if len(r) > ncols]
+    if not parsed:
+        return None
+    est_rows = max(
+        int(os.path.getsize(in_path) * len(parsed) / max(consumed, 1)),
+        len(parsed))
+    width = min(len(r) for r in parsed)
+    try:
+        ds = enc.fit_transform(
+            np.asarray([r[:width] for r in parsed], dtype=object))
+    except Exception:
+        return None
+    return ds, est_rows
+
+
+def _sum_costs(parts: List[Optional[dict]]) -> Optional[dict]:
+    if not parts or any(p is None for p in parts):
+        return None
+    out: dict = {}
+    for key in ("flops", "bytes_accessed", "output_bytes", "temp_bytes"):
+        vals = [p.get(key) for p in parts]
+        out[key] = (None if any(v is None for v in vals)
+                    else float(sum(vals)))
+    return out
+
+
+def _score(cost: Optional[dict]) -> Optional[float]:
+    """One comparable scalar per candidate program: compute plus traffic
+    (a crude roofline sum — both terms cost wall time; either alone can
+    be zero on a backend that reports only the other)."""
+    if cost is None:
+        return None
+    flops, by = cost.get("flops"), cost.get("bytes_accessed")
+    if flops is None and by is None:
+        return None
+    return float(flops or 0.0) + float(by or 0.0)
+
+
+# AOT estimates are pure in (program, operand shapes) — memoized process-
+# wide so re-planning the same pipeline (a resumed run, the benchmark's
+# best-of passes) pays XLA's lower+compile once, like the jit cache
+_AOT_CACHE: Dict[tuple, Optional[dict]] = {}
+
+
+def _shape_sig(args, kwargs) -> tuple:
+    sig = []
+    for a in args:
+        if hasattr(a, "shape"):
+            sig.append((tuple(a.shape), str(a.dtype)))
+        else:
+            sig.append(repr(a))
+    return (tuple(sig), tuple(sorted((kwargs or {}).items())))
+
+
+def _cached_aot(tag: str, lowerable, args=(), kwargs=None
+                ) -> Optional[dict]:
+    from avenir_tpu.telemetry import profile as _profile
+
+    key = (tag, _shape_sig(args, kwargs))
+    if key not in _AOT_CACHE:
+        _AOT_CACHE[key] = _profile.aot_cost(lowerable, args, kwargs)
+    return _AOT_CACHE[key]
+
+
+# Measured sample-chunk walls, same key discipline.  The AOT *cost model*
+# cannot rank packed-vs-einsum on real hardware: the packed gram is one
+# dense matmul (huge nominal flops, near-peak execution) while the einsum
+# family is many scatter-shaped dispatches (tiny nominal flops, dispatch-
+# and memory-bound) — flops+bytes anti-correlates with wall between the
+# two styles.  So the selection dispatches each ahead-of-time-compiled
+# candidate ONCE over the peeked sample and compares measured wall; the
+# AOT estimates still ride the plan (journal + explain) as the portable
+# cost record.
+_WALL_CACHE: Dict[tuple, Optional[float]] = {}
+
+
+def _measured_ms(tag: str, fn, args, kwargs=None) -> Optional[float]:
+    import time
+
+    import jax
+
+    key = (tag, _shape_sig(args, kwargs))
+    if key in _WALL_CACHE:
+        return _WALL_CACHE[key]
+    kw = kwargs or {}
+    try:
+        jax.block_until_ready(fn(*args, **kw))      # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            # the sync IS the measurement: this is a plan-time timing
+            # probe, so each dispatch must drain before the clock reads
+            jax.block_until_ready(fn(*args, **kw))  # graftlint: disable=GL005
+            best = min(best, time.perf_counter() - t0)
+        _WALL_CACHE[key] = best * 1000.0
+    except Exception:
+        _WALL_CACHE[key] = None
+    return _WALL_CACHE[key]
+
+
+def _einsum_wall_ms(folder, ds) -> Optional[float]:
+    """Measured wall of the per-table einsum family over the sample —
+    the same component programs :func:`_einsum_cost` lowers."""
+    from avenir_tpu.ops import agg
+
+    walls = [_measured_ms("class_counts", agg.class_counts, (ds.labels,),
+                          {"num_classes": folder.c})]
+    if folder.needs_counts:
+        walls.append(_measured_ms(
+            "feature_class_counts",
+            agg.feature_class_counts, (ds.codes, ds.labels),
+            {"num_classes": folder.c, "num_bins": folder.b}))
+        npairs = len(folder.pair_index)
+        if npairs:
+            sl = folder.pair_index[:min(folder.pair_chunk, npairs)]
+            one = _measured_ms(
+                "pair_class_counts", agg.pair_class_counts,
+                (ds.codes[:, sl[:, 0]], ds.codes[:, sl[:, 1]], ds.labels),
+                {"num_classes": folder.c, "num_bins": folder.b})
+            walls.append(None if one is None else one * (npairs / len(sl)))
+    if folder.needs_moments:
+        walls.append(_measured_ms("class_moments", agg.class_moments,
+                                  (ds.cont, ds.labels),
+                                  {"num_classes": folder.c}))
+    if any(w is None for w in walls):
+        return None
+    return float(sum(walls))
+
+
+def _probe_wall_ms(folder, ds) -> Optional[float]:
+    probe = folder.cost_probe(ds)
+    if probe is None:
+        return None
+    return _measured_ms(folder.program_tag, probe[0], probe[1])
+
+
+def _einsum_cost(folder, ds) -> Optional[dict]:
+    """The summed AOT estimate of the per-table einsum family one chunk
+    dispatches — class counts + [F, B, C] + the pair-chunk series (one
+    representative slice lowered, scaled to the union) + moments."""
+    from avenir_tpu.ops import agg
+
+    parts = [_cached_aot("class_counts", agg.class_counts, (ds.labels,),
+                         {"num_classes": folder.c})]
+    if folder.needs_counts:
+        parts.append(_cached_aot(
+            "feature_class_counts",
+            agg.feature_class_counts, (ds.codes, ds.labels),
+            {"num_classes": folder.c, "num_bins": folder.b}))
+        npairs = len(folder.pair_index)
+        if npairs:
+            sl = folder.pair_index[:min(folder.pair_chunk, npairs)]
+            one = _cached_aot(
+                "pair_class_counts", agg.pair_class_counts,
+                (ds.codes[:, sl[:, 0]], ds.codes[:, sl[:, 1]], ds.labels),
+                {"num_classes": folder.c, "num_bins": folder.b})
+            if one is None:
+                return None
+            scale = npairs / len(sl)
+            one = {k: (v * scale if isinstance(v, (int, float)) else v)
+                   for k, v in one.items()}
+            parts.append(one)
+    if folder.needs_moments:
+        parts.append(_cached_aot("class_moments", agg.class_moments,
+                                 (ds.cont, ds.labels),
+                                 {"num_classes": folder.c}))
+    return _sum_costs(parts)
+
+
+def _probe_cost(folder, ds, site: str) -> Optional[dict]:
+    """AOT cost of a single-dispatch routing via the folder's own cost
+    probe, registered with the CompiledProgramRegistry when profiling is
+    on (the plan's candidates become ``program.compiled`` records)."""
+    from avenir_tpu.telemetry import profile as _profile
+    from avenir_tpu.telemetry import spans as tel
+
+    probe = folder.cost_probe(ds)
+    if probe is None:
+        return None
+    prof = _profile.profiler()
+    if prof.enabled:
+        key = tel.CompileKeyMonitor.shape_key(ds.codes, ds.labels, ds.cont
+                                              ) + (folder.program_tag,)
+        prof.observe(key, site=site, lowerable=probe[0], args=probe[1])
+    return _cached_aot(folder.program_tag, probe[0], probe[1])
+
+
+def _estimate(unit: ScanUnit, schema, enc, peek) -> None:
+    """Fill the unit's predicted routing + cost, and make the PackGraft
+    selection at plan time: compile the packed gram and the einsum family
+    ahead of time over the peeked sample, measure one dispatch of each,
+    and choose the faster program (the AOT estimates ride the plan as the
+    portable cost record; the raw flops+bytes score is only the fallback
+    ranking — see ``_WALL_CACHE``).  Falls back to the runtime width
+    heuristic (``pack_on=None``, source "model") when neither measurement
+    nor AOT analysis is available, or no sample exists."""
+    from avenir_tpu.jobs.base import Job
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.pipeline import scan
+
+    conf = unit.confs[0]
+    if ShardSpec.requested(conf):
+        unit.program = "shard"
+        return
+    mesh = Job.auto_mesh(conf)
+    if peek is None:
+        unit.program = "sharded" if mesh is not None else unit.program
+        unit.pack_source = "model"
+        return
+    sample, est_rows = peek
+    chunk_rows = conf.get_int("stream.chunk.rows", 0) or est_rows
+    view = (sample if unit.keep is None
+            else scan.pruned_view(sample, np.asarray(unit.keep, np.int64)))
+    consumers = [scan.stage_consumer(s.name, s.job, c, "", schema, enc,
+                                     keep=unit.keep)[0]
+                 for s, c in zip(unit.stages, unit.confs)]
+    pmw = conf.get_int("scan.pack.max.width", 0) or None
+    base = scan.ChunkFolder(consumers, view, pack_on=False,
+                            pack_max_width=pmw)
+    unit.cost_rows = view.num_rows
+    if mesh is not None:
+        # auto data-parallel mesh: the per-device program is the same
+        # einsum family (pack requires a single device) — estimate the
+        # per-chunk work, leave the pack question to nobody
+        unit.program = "sharded"
+        unit.cost = _einsum_cost(base, view)
+        unit.pack_source = "aot" if unit.cost is not None else "model"
+        return
+    if base.step != "einsum":
+        # kernel / moments-only: a single program with no pack question
+        unit.cost = _probe_cost(base, view, "plan.candidate")
+        unit.program = base.program_tag or "moments"
+        unit.pack_source = "aot" if unit.cost is not None else "model"
+        return
+    packed = None
+    if conf.get_bool("scan.pack.on", True):
+        packed = scan.ChunkFolder(consumers, view, pack_on=True,
+                                  pack_max_width=pmw)
+        if packed.step != "packed":
+            packed = None           # the pack planner found no viable pack
+    cost_e = _einsum_cost(base, view)
+    cost_p = (_probe_cost(packed, view, "plan.candidate")
+              if packed is not None else None)
+    if packed is not None:
+        # primary selection: measured dispatches at two sample sizes fit
+        # a per-candidate wall(N) = a + b*N line, evaluated at the run's
+        # ACTUAL chunk size — the packed gram trades a large fixed
+        # dispatch (b*W^2 work per row is tiny, the intercept is not)
+        # against the einsum family's many small dispatches, so the
+        # ranking flips with N and a sample-sized comparison misleads
+        n = view.num_rows
+        n_small = max(min(n // 8, n - 1), 1)
+        small = view.slice(0, n_small) if n_small < n else None
+
+        def predicted(wall_fn, folder):
+            w1 = wall_fn(folder, view)
+            if w1 is None:
+                return None
+            if small is None or chunk_rows <= n:
+                return w1
+            w0 = wall_fn(folder, small)
+            if w0 is None:
+                return w1 * chunk_rows / n
+            b = (w1 - w0) / (n - n_small)
+            a = max(w1 - b * n, 0.0)
+            return a + max(b, 0.0) * chunk_rows
+
+        wall_e = predicted(_einsum_wall_ms, base)
+        wall_p = predicted(_probe_wall_ms, packed)
+        if wall_e is not None and wall_p is not None:
+            choose_packed = wall_p <= wall_e
+            unit.pack_source = "measured"
+            unit.pack_on = choose_packed
+            unit.cost = cost_p if choose_packed else cost_e
+            unit.wall_ms = wall_p if choose_packed else wall_e
+            unit.program = (packed.program_tag if choose_packed
+                            else base.program_tag)
+            if choose_packed:
+                unit.rewrites.append("pack")
+            return
+    if packed is None:
+        # no pack candidate (opt-out, or no viable pack plan) — the
+        # einsum family is the program; record its estimate
+        unit.pack_source = "aot" if cost_e is not None else "model"
+        unit.cost = cost_e
+        unit.program = base.program_tag
+        return
+    se, sp = _score(cost_e), _score(cost_p)
+    if se is not None and sp is not None:
+        choose_packed = sp <= se
+        unit.pack_source = "aot"
+        unit.pack_on = choose_packed
+        unit.cost = cost_p if choose_packed else cost_e
+        unit.program = (packed.program_tag if choose_packed
+                        else base.program_tag)
+        if choose_packed:
+            unit.rewrites.append("pack")
+        return
+    # AOT degraded to shapes-only — defer to the runtime width heuristic,
+    # which packs exactly when pack_tables found a plan
+    unit.pack_source = "model"
+    unit.pack_on = None
+    unit.cost = cost_p if cost_p is not None else cost_e
+    unit.program = (packed.program_tag if packed is not None
+                    else base.program_tag)
+    if packed is not None:
+        unit.rewrites.append("pack")
+
+
+def plan_pipeline(pipeline: Pipeline,
+                  todo: Optional[Sequence[Stage]] = None,
+                  resume: bool = False) -> PipelinePlan:
+    """Lower a pipeline's declared stage DAG into an ordered unit list.
+
+    Greedy over declared order: each unclaimed fusable stage anchors a
+    scan unit and pulls in every later dependency-safe fusable stage over
+    the same input artifact (``_join_shares``); non-fusable stages become
+    staged fallbacks with their refusal reason; under ``resume``,
+    satisfied stages become skip units.  Per scan unit the planner then
+    computes the dead-column set, the encode-once cache key, and the
+    AOT-costed pack selection over a peeked sample chunk
+    (``plan.peek.rows``, default 512)."""
+    from avenir_tpu.jobs.base import Job
+    from avenir_tpu.parallel.shard import ShardSpec
+    from avenir_tpu.pipeline import scan
+
+    stages = list(todo) if todo is not None else list(pipeline.stages)
+    confs = {s.name: pipeline._stage_conf(s) for s in stages}
+    producers = {s.output: s for s in stages}
+    pos = {s.name: k for k, s in enumerate(stages)}
+    units: List[object] = []
+    taken: set = set()
+    encode_seen: set = set()
+    samples: Dict[str, object] = {}
+    for i, s in enumerate(stages):
+        if s.name in taken:
+            continue
+        conf = confs[s.name]
+        if resume and os.path.exists(pipeline.path(s.output)):
+            units.append(SkipUnit(stage=s))
+            taken.add(s.name)
+            continue
+        reason = scan.fuse_refusal(s.job, conf)
+        if reason is not None:
+            units.append(StageUnit(stage=s, conf=conf, reason=reason))
+            taken.add(s.name)
+            continue
+        in_path = pipeline.path(s.input)
+        members, mconfs = [s], [conf]
+        member_names, member_outs = {s.name}, {s.output}
+        shares: List[str] = []
+        for j in range(i + 1, len(stages)):
+            c = stages[j]
+            if c.name in taken or c.name in member_names:
+                continue
+            if resume and os.path.exists(pipeline.path(c.output)):
+                continue           # becomes a SkipUnit at its own slot
+            if pipeline.path(c.input) != in_path:
+                continue
+            cconf = confs[c.name]
+            if scan.fuse_refusal(c.job, cconf) is not None:
+                continue
+            if not scan.stages_compatible([mconfs[0], cconf]):
+                continue
+            share = _join_shares(pipeline, c, producers, taken,
+                                 member_names, member_outs, stages, i, j,
+                                 in_path)
+            if share is None:
+                continue
+            members.append(c)
+            mconfs.append(cconf)
+            member_names.add(c.name)
+            member_outs.add(c.output)
+            shares.extend(share)
+        if not scan.stages_compatible(mconfs[:1]):
+            # schema unloadable or no class attribute — the SharedScan
+            # cannot serve even a singleton; keep the staged job path
+            units.append(StageUnit(stage=s, conf=conf,
+                                   reason="scan-incompatible conf "
+                                          "(schema/class attribute)"))
+            taken.add(s.name)
+            continue
+        unit = ScanUnit(stages=members, confs=mconfs, input=s.input,
+                        in_path=in_path)
+        if len(members) > 1:
+            unit.rewrites.append("fuse")
+        if shares:
+            unit.rewrites.append("share-gram")
+        # dead-column pruning: the union of binned columns any member's
+        # output depends on; None (NB/MI — every column) blocks the rewrite
+        schema = Job.load_schema(mconfs[0])
+        enc = Job.encoder_for(mconfs[0])
+        f = len(enc.binned_fields)
+        needed: Optional[set] = set()
+        for m, mc in zip(members, mconfs):
+            cons, _w = scan.stage_consumer(m.name, m.job, mc, "", schema,
+                                           enc)
+            cols = scan.consumer_columns(cons, f)
+            if cols is None:
+                needed = None
+                break
+            needed |= cols
+        if needed is not None and needed and len(needed) < f:
+            unit.keep = sorted(needed)
+            unit.pruned_from = f
+            unit.rewrites.append("prune")
+        # a singleton with no prune win and no shard topology runs its
+        # standalone job byte-identically — keep the staged path (same
+        # rule as the driver's _scan_group singleton gate)
+        if len(members) == 1 and unit.keep is None \
+                and not ShardSpec.requested(conf):
+            units.append(StageUnit(stage=s, conf=conf,
+                                   reason="singleton scan -- staged path "
+                                          "is identical"))
+            taken.add(s.name)
+            continue
+        mconf = mconfs[0]
+        if not mconf.get("stream.chunk.rows") \
+                and not ShardSpec.requested(mconf):
+            ekey = ((in_path,)
+                    + tuple(mconf.get(k) for k in scan._ENCODE_KEYS))
+            if ekey in encode_seen:
+                unit.rewrites.append("encode-once")
+            encode_seen.add(ekey)
+        ps = sorted(pos[m.name] for m in members)
+        unit.staged_scans = 1 + sum(1 for a, b in zip(ps, ps[1:])
+                                    if b != a + 1)
+        if in_path not in samples:
+            samples[in_path] = _peek_sample(
+                mconf, in_path, mconf.get_int("plan.peek.rows", 2048))
+        _estimate(unit, schema, enc, samples[in_path])
+        units.append(unit)
+        taken.update(member_names)
+    return PipelinePlan(pipeline, units, resume)
+
+
+# ---------------------------------------------------------------------------
+# execution + journal
+# ---------------------------------------------------------------------------
+
+def journal_plan(summary: dict, tracer=None) -> None:
+    """One golden-schema'd ``plan.compiled`` event per planned run — the
+    journal's record of what the planner decided before anything executed
+    (tests/test_telemetry.py pins the exact key set)."""
+    from avenir_tpu.telemetry import spans as tel
+
+    (tracer or tel.tracer()).event("plan.compiled", **summary)
+
+
+def run_plan(pipeline: Pipeline, plan: PipelinePlan, tracer) -> None:
+    """Execute a plan in unit order: skip units journal ``stage.skipped``
+    (counters marked in place), staged fallbacks run the normal per-stage
+    path, and scan units run through ``scan.run_fused_stages`` with the
+    plan's prune/pack decisions — sharing one encode cache across units
+    (encode-once) and carrying the plan-node attrs on each fused span."""
+    cache: dict = {}
+    for k, unit in enumerate(plan.units):
+        if isinstance(unit, SkipUnit):
+            pipeline._mark_skipped(unit.stage, tracer)
+        elif isinstance(unit, StageUnit):
+            pipeline._run_single(unit.stage, unit.conf, tracer)
+        else:
+            extra = {"planned": True, "unit": k,
+                     "rewrites": list(unit.rewrites)}
+            if unit.program:
+                extra["plan.program"] = unit.program
+            pipeline._run_fused(
+                unit.stages, unit.confs, tracer, extra_attrs=extra,
+                prune=unit.keep, pack_on=unit.pack_on,
+                pack_max_width=unit.pack_max_width, encode_cache=cache)

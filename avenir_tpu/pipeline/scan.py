@@ -838,34 +838,46 @@ _COMPAT_KEYS = ("feature.schema.file.path", "field.delim.regex",
                 "scan.pack.on", "scan.pack.max.width")
 
 
-def stage_fusable(job, conf) -> bool:
-    """Can this (job name, stage conf) ride a SharedScan?  Conservative:
-    anything the fused path does not reproduce byte-for-byte — per-stage
-    opt-out, text-mode NB, per-job stream checkpointing — keeps the stage
-    on its own scan.  Multi-process runs fuse ONLY under an explicit
-    ``shard.*`` topology (CrossGraft: the global fold row-partitions each
-    chunk across processes inside the dispatch); without one, the per-job
-    round-robin chunk ownership + ``all_process_sum_state`` path remains
-    the multi-process contract."""
+def fuse_refusal(job, conf) -> Optional[str]:
+    """Why this (job name, stage conf) cannot ride a SharedScan — or None
+    when it can.  Conservative: anything the fused path does not reproduce
+    byte-for-byte — per-stage opt-out, text-mode NB, per-job stream
+    checkpointing — keeps the stage on its own scan.  Multi-process runs
+    fuse ONLY under an explicit ``shard.*`` topology (CrossGraft: the
+    global fold row-partitions each chunk across processes inside the
+    dispatch); without one, the per-job round-robin chunk ownership +
+    ``all_process_sum_state`` path remains the multi-process contract.
+
+    The ONE gate shared by the driver's consecutive-stage fusion
+    (``stage_fusable``) and the PlanGraft planner (``pipeline/plan.py``),
+    which surfaces the reason string in ``plan explain`` fallback nodes."""
     if not isinstance(job, str) or job not in FUSABLE_JOBS:
-        return False
+        return "not a fusable count job"
     if not conf.get_bool("scan.fuse", True):
-        return False
+        return "scan.fuse=false opt-out"
     if conf.get("stream.checkpoint.dir"):
-        return False          # per-job durability is not composed with fusion
+        # per-job durability is not composed with fusion
+        return "checkpointed stream (stream.checkpoint.dir)"
     if job == "BayesianDistribution" and not conf.get_bool("tabular.input", True):
-        return False
+        return "text-mode NB (tabular.input=false)"
     if not conf.get("feature.schema.file.path"):
-        return False
+        return "no schema (feature.schema.file.path unset)"
     import jax
 
     from avenir_tpu.parallel.shard import ShardSpec
     try:
         if jax.process_count() > 1 and not ShardSpec.requested(conf):
-            return False      # round-robin chunk ownership is per-job
+            # round-robin chunk ownership is per-job
+            return "multi-process without a shard.* topology"
     except Exception:                              # pragma: no cover
-        return False
-    return True
+        return "process topology unavailable"
+    return None
+
+
+def stage_fusable(job, conf) -> bool:
+    """Can this (job name, stage conf) ride a SharedScan?  See
+    :func:`fuse_refusal` for the reasons a stage stays on its own scan."""
+    return fuse_refusal(job, conf) is None
 
 
 def stages_compatible(confs) -> bool:
@@ -884,7 +896,114 @@ def stages_compatible(confs) -> bool:
     return schema.class_field is not None
 
 
-def run_fused_stages(stages) -> Dict[str, Counters]:
+def stage_consumer(name, job, conf, out_path, schema, enc,
+                   counters: Optional[Counters] = None,
+                   keep: Optional[Sequence[int]] = None):
+    """``(consumer, writer)`` for one fusable stage — the ONE construction
+    shared by :func:`run_fused_stages` and the PlanGraft planner
+    (``pipeline/plan.py``), which builds consumers data-free to compute
+    pair unions, prunable columns and AOT cost estimates before any row
+    is read.  ``keep`` (the sorted binned positions the planner's
+    dead-column rewrite retains) remaps a correlation stage's attribute
+    selection into the pruned space; the all-column consumers (NB, MI)
+    refuse it.  The writer publishes the finalized result byte-identically
+    to the standalone job; ``counters`` receives NB's model-row count."""
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import write_output
+    from avenir_tpu.jobs.explore import correlation_plan, mi_output_lines
+    from avenir_tpu.models import naive_bayes as nb
+
+    if job == "BayesianDistribution":
+        if keep is not None:
+            raise ScanError("NB reads every binned column; cannot prune")
+        consumer = NaiveBayesConsumer(
+            laplace=conf.get_float("laplace.smoothing", 1.0), name=name)
+
+        def write_nb(model):
+            lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
+            write_output(out_path, lines)
+            if counters is not None:
+                counters.set("Model", "Rows", len(lines))
+
+        return consumer, write_nb
+    if job == "MutualInformation":
+        if keep is not None:
+            raise ScanError("MI aggregates every pair; cannot prune")
+        names_ = [schema.field_by_ordinal(fld.ordinal).name
+                  for fld in enc.binned_fields]
+        consumer = MutualInfoConsumer(feature_names=names_, name=name)
+
+        def write_mi(result):
+            write_output(out_path, mi_output_lines(conf, result, names_))
+
+        return consumer, write_mi
+    # CramerCorrelation / HeterogeneityReductionCorrelation
+    src_idx, dst_idx, against_class, names_ = correlation_plan(
+        conf, schema, enc)
+    if keep is not None:
+        # remap the full-space attribute selection into the pruned space;
+        # a None selection means "every column", which the planner only
+        # prunes to itself — so both restricted lists are present here
+        pos = {int(c): k for k, c in enumerate(keep)}
+        src_idx = None if src_idx is None else [pos[i] for i in src_idx]
+        dst_idx = None if dst_idx is None else [pos[i] for i in dst_idx]
+        names_ = [names_[int(c)] for c in keep]
+    algorithm = get_job(job)._algorithm(conf)
+    consumer = CorrelationConsumer(
+        algorithm=algorithm, src=src_idx, dst=dst_idx,
+        against_class=against_class, feature_names=names_, name=name)
+
+    def write_corr(result):
+        write_output(out_path, result.to_lines(delim=conf.field_delim))
+
+    return consumer, write_corr
+
+
+def consumer_columns(consumer, num_binned: int) -> Optional[set]:
+    """The binned columns a consumer reads, or None for "all" — drives the
+    planner's dead-column rewrite.  NB's model and MI's all-pairs tensors
+    cover every column; a correlation stage restricted to explicit
+    source/dest attributes touches only their union (the statistic slices
+    each pair to its true ``n_bins`` support, so folding a narrower codes
+    block reproduces the same output bytes)."""
+    if not isinstance(consumer, CorrelationConsumer):
+        return None
+    if consumer.against_class:
+        return None if consumer.src is None else set(int(i)
+                                                     for i in consumer.src)
+    if consumer.src is None or consumer.dst is None:
+        return None
+    cols: set = set()
+    for i, j in consumer._pair_list(num_binned):
+        cols.add(int(i))
+        cols.add(int(j))
+    return cols
+
+
+# conf keys that shape the encoded bytes of a whole-input read — the
+# planner's encode-once cache key (streaming/shard staging is per-unit)
+_ENCODE_KEYS = ("feature.schema.file.path", "field.delim.regex",
+                "field.delim")
+
+
+def pruned_view(ds: EncodedDataset, keep: np.ndarray) -> EncodedDataset:
+    """The dead-column rewrite applied to one chunk: the kept binned
+    columns' codes/cardinalities/ordinals, everything else untouched.
+    A host-side gather per chunk — the device fold then runs on the
+    narrower gram."""
+    return EncodedDataset(
+        codes=ds.codes[:, keep], cont=ds.cont, labels=ds.labels, ids=ds.ids,
+        n_bins=np.asarray(ds.n_bins)[keep],
+        class_values=ds.class_values,
+        binned_ordinals=[ds.binned_ordinals[int(k)] for k in keep],
+        cont_ordinals=ds.cont_ordinals, valid_rows=ds.valid_rows)
+
+
+def run_fused_stages(stages, prune: Optional[Sequence[int]] = None,
+                     pack_on: Optional[bool] = None,
+                     pack_max_width: Optional[int] = None,
+                     encode_cache: Optional[dict] = None
+                     ) -> Dict[str, Counters]:
     """Execute a group of fusable pipeline stages as ONE SharedScan.
 
     ``stages``: list of ``(name, job, input_path, output_path, conf)`` with
@@ -893,11 +1012,17 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
     (native parse → encode → DeviceFeeder staging, once), registers one
     consumer per stage, runs the scan, and writes each stage's output
     byte-identically to its standalone job.  Returns per-stage Counters;
-    each carries a ``SharedScan`` counter group attesting the fusion."""
-    from avenir_tpu.jobs import get_job
-    from avenir_tpu.jobs.base import Job, write_output
-    from avenir_tpu.jobs.explore import correlation_plan, mi_output_lines
-    from avenir_tpu.models import naive_bayes as nb
+    each carries a ``SharedScan`` counter group attesting the fusion.
+
+    The PlanGraft planner (``pipeline/plan.py``) drives the same seam with
+    its plan-time decisions: ``prune`` folds only the listed binned
+    columns (consumers remapped into the pruned space — byte-identical by
+    the true-support contract), ``pack_on``/``pack_max_width`` override
+    the runtime pack heuristic with the planner's AOT-costed choice (the
+    conf's ``scan.pack.on=false`` opt-out still wins), and
+    ``encode_cache`` lets a whole-input encode be reused by every scan
+    unit reading the same artifact under the same encode keys."""
+    from avenir_tpu.jobs.base import Job
 
     first_conf = stages[0][4]
     in_path = stages[0][2]
@@ -916,47 +1041,43 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
     # group) — one scan, one accounting home
     if spec is not None:
         spec.announce()       # deduped per journal — one event per run
-    enc, data, rows_fn = job_obj.encoded_data_source(
-        first_conf, in_path, counters[stages[0][0]], mesh=mesh, shard=spec)
+    ckey = None
+    if (encode_cache is not None and spec is None
+            and not first_conf.get("stream.chunk.rows")):
+        ckey = (in_path,) + tuple(first_conf.get(k) for k in _ENCODE_KEYS)
+    if ckey is not None and ckey in encode_cache:
+        enc, data = encode_cache[ckey]
+        rows_fn = (lambda d=data: d.num_rows)
+    else:
+        enc, data, rows_fn = job_obj.encoded_data_source(
+            first_conf, in_path, counters[stages[0][0]], mesh=mesh,
+            shard=spec)
+        if ckey is not None and isinstance(data, EncodedDataset):
+            encode_cache[ckey] = (enc, data)
+    keep = None
+    if prune is not None:
+        keep = np.asarray(sorted(int(c) for c in prune), np.int64)
+        if keep.size == len(enc.binned_fields):
+            keep = None            # nothing dead — fold the full width
     engine = SharedScan(
         mesh=mesh, shard=spec, counters=counters[stages[0][0]],
-        pack_on=first_conf.get_bool("scan.pack.on", True),
-        pack_max_width=first_conf.get_int("scan.pack.max.width", 0) or None)
+        pack_on=(first_conf.get_bool("scan.pack.on", True) if pack_on is None
+                 else pack_on and first_conf.get_bool("scan.pack.on", True)),
+        pack_max_width=(first_conf.get_int("scan.pack.max.width", 0) or None
+                        if pack_max_width is None else pack_max_width))
     writers = {}
     for name, job, _inp, out_path, conf in stages:
-        if job == "BayesianDistribution":
-            engine.register(NaiveBayesConsumer(
-                laplace=conf.get_float("laplace.smoothing", 1.0), name=name))
-
-            def write_nb(model, conf=conf, out=out_path, name=name):
-                lines = nb.model_to_lines(model, enc, delim=conf.field_delim)
-                write_output(out, lines)
-                counters[name].set("Model", "Rows", len(lines))
-
-            writers[name] = write_nb
-        elif job == "MutualInformation":
-            names_ = [schema.field_by_ordinal(fld.ordinal).name
-                      for fld in enc.binned_fields]
-            engine.register(MutualInfoConsumer(feature_names=names_,
-                                               name=name))
-
-            def write_mi(result, conf=conf, out=out_path, names_=names_):
-                write_output(out, mi_output_lines(conf, result, names_))
-
-            writers[name] = write_mi
-        else:                  # CramerCorrelation / HeterogeneityReduction...
-            src_idx, dst_idx, against_class, names_ = correlation_plan(
-                conf, schema, enc)
-            algorithm = get_job(job)._algorithm(conf)
-            engine.register(CorrelationConsumer(
-                algorithm=algorithm, src=src_idx, dst=dst_idx,
-                against_class=against_class, feature_names=names_, name=name))
-
-            def write_corr(result, conf=conf, out=out_path):
-                write_output(out, result.to_lines(delim=conf.field_delim))
-
-            writers[name] = write_corr
-    results = engine.run(data)
+        consumer, writers[name] = stage_consumer(
+            name, job, conf, out_path, schema, enc,
+            counters=counters[name],
+            keep=None if keep is None else [int(k) for k in keep])
+        engine.register(consumer)
+    scan_data = data
+    if keep is not None:
+        scan_data = (pruned_view(data, keep)
+                     if isinstance(data, EncodedDataset)
+                     else (pruned_view(ds, keep) for ds in data))
+    results = engine.run(scan_data)
     rows = rows_fn()
     for name, _job, _inp, _out, _conf in stages:
         # CrossGraft: under a global plan every process finalizes the
@@ -968,4 +1089,7 @@ def run_fused_stages(stages) -> Dict[str, Counters]:
         counters[name].set("SharedScan", "FusedStages", len(stages))
         counters[name].set("SharedScan", "Scans", 1)
         counters[name].set("SharedScan", "Chunks", engine.chunks_seen)
+        if keep is not None:
+            counters[name].set("SharedScan", "PrunedCols",
+                               len(enc.binned_fields) - int(keep.size))
     return counters

@@ -128,6 +128,19 @@ def extract_metrics(artifact: dict) -> Dict[str, dict]:
                 entry = _row_entry(row)
                 if entry is not None:
                     out[f"packed.{name}"] = entry
+
+    # PlanGraft (round 19): the e2e bench's planned-vs-staged section
+    # publishes a nested "planned" block the same way — plan_speedup is
+    # the banded row (a shared-rig ratio, so no canary fields, exactly
+    # like pack_speedup); scan-second rows ride the conventions above
+    planned = line.get("planned")
+    if isinstance(planned, dict):
+        for name in sorted(planned):
+            row = planned[name]
+            if isinstance(row, dict):
+                entry = _row_entry(row)
+                if entry is not None:
+                    out[f"planned.{name}"] = entry
     return out
 
 

@@ -398,6 +398,11 @@ def test_whole_tree_zero_nonbaselined_findings():
     # key (GL004), an unguarded writer near the join collective (GL001),
     # or a sync-in-loop around the fused dispatch (GL005) would hide
     # (avenir_tpu/launch/ itself sits inside the avenir_tpu tree)
+    # tests/test_plan.py likewise (round 19) — the PlanGraft byte-identity
+    # gate drives the planner's rewrite/fallback drills, where an
+    # undocumented plan.*/pipeline.* key (GL004) or a sync-in-loop around
+    # the measured-dispatch cost probes (GL005) would hide
+    # (pipeline/plan.py itself sits inside the avenir_tpu tree)
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
@@ -414,7 +419,8 @@ def test_whole_tree_zero_nonbaselined_findings():
          str(REPO / "tests" / "test_pool.py"),
          str(REPO / "tests" / "test_tenancy.py"),
          str(REPO / "tests" / "crossgraft_worker.py"),
-         str(REPO / "tests" / "test_multiprocess.py")],
+         str(REPO / "tests" / "test_multiprocess.py"),
+         str(REPO / "tests" / "test_plan.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

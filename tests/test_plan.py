@@ -1,0 +1,526 @@
+"""PlanGraft planner: byte-identity of every rewrite against the staged
+path (the oracle), resume semantics under planning, staged fallbacks for
+checkpointed / text-mode / multi-process stages, and the plan explain /
+``plan.compiled`` journal surfaces."""
+
+import functools
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.ops import pallas_hist
+from avenir_tpu.pipeline import plan as plan_mod
+from avenir_tpu.pipeline import scan
+from avenir_tpu.pipeline.driver import Pipeline, Stage
+from avenir_tpu.pipeline.plan import ScanUnit, SkipUnit, StageUnit
+from avenir_tpu.utils.metrics import Counters
+
+COUNT_ARTS = ("nb_model", "mi_out", "cramer_out", "het_out")
+
+
+@pytest.fixture(scope="module")
+def plan_env(tmp_path_factory):
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+
+    root = tmp_path_factory.mktemp("plan_pipeline")
+    rows = generate_churn(2000, seed=11)
+    write_csv(str(root / "train.csv"), rows)
+    schema_path = root / "churn.json"
+    schema_path.write_text(json.dumps(CHURN_SCHEMA_JSON))
+    schema = FeatureSchema.from_json(CHURN_SCHEMA_JSON)
+    conf = JobConfig({"feature.schema.file.path": str(schema_path)})
+    return root, conf, schema
+
+
+def _marker_stage(name="marker", output="marker_out"):
+    """A non-fusable callable stage — breaks driver adjacency without
+    touching the shared input artifact."""
+
+    def marker(conf, in_path, out_path):
+        os.makedirs(out_path, exist_ok=True)
+        with open(os.path.join(out_path, "part-00000"), "w") as fh:
+            fh.write("marker\n")
+        return Counters()
+
+    return Stage(name, marker, "data", output)
+
+
+def _interleaved_pipeline(ws, conf, class_ord):
+    """NB | marker | MI | Cramér | het — the staged path pays TWO scans
+    (the marker splits the group); the planner hoists past it."""
+    p = Pipeline(str(ws), conf)
+    p.add(Stage("bayesianDistr", "BayesianDistribution", "data", "nb_model"))
+    p.add(_marker_stage())
+    p.add(Stage("mutualInfo", "MutualInformation", "data", "mi_out"))
+    p.add(Stage("cramer", "CramerCorrelation", "data", "cramer_out",
+                props={"dest.attributes": str(class_ord)}))
+    p.add(Stage("het", "HeterogeneityReductionCorrelation", "data", "het_out",
+                props={"heterogeneity.algorithm": "uncertainty"}))
+    return p
+
+
+@pytest.fixture(scope="module")
+def staged_outputs(plan_env):
+    """Unfused (scan.fuse=false) staged reference: artifact → bytes."""
+    root, conf, schema = plan_env
+    unconf = JobConfig(dict(conf.props))
+    unconf.set("scan.fuse", "false")
+    p = _interleaved_pipeline(root / "ws_plain", unconf,
+                              schema.class_field.ordinal)
+    p.bind("data", str(root / "train.csv"))
+    p.run()
+    return {art: (root / "ws_plain" / art / "part-00000").read_bytes()
+            for art in COUNT_ARTS + ("marker_out",)}
+
+
+def _run_planned(root, conf, schema, ws, extra=None, mutate=None,
+                 resume=False):
+    pconf = JobConfig(dict(conf.props))
+    pconf.set("plan.on", "true")
+    for k, v in (extra or {}).items():
+        pconf.set(k, v)
+    p = _interleaved_pipeline(root / ws, pconf, schema.class_field.ordinal)
+    if mutate:
+        mutate(p)
+    p.bind("data", str(root / "train.csv"))
+    return p, p.run(resume=resume)
+
+
+def _assert_bytes(root, ws, staged_outputs, arts=None):
+    for art in (arts or staged_outputs):
+        got = (root / ws / art / "part-00000").read_bytes()
+        assert got == staged_outputs[art], f"planned {art} differs"
+
+
+# ---------------------------------------------------------------------------
+# the fuse rewrite: non-adjacent stages ride ONE scan
+# ---------------------------------------------------------------------------
+
+def test_plan_fuses_nonadjacent_byte_identical(plan_env, staged_outputs):
+    """The marker stage splits the driver's consecutive grouping into two
+    scans; the planner hoists past it — all four count stages in ONE scan,
+    every artifact byte-identical to the staged run."""
+    root, conf, schema = plan_env
+    p, counters = _run_planned(root, conf, schema, "ws_planned")
+    _assert_bytes(root, "ws_planned", staged_outputs)
+    for name in ("bayesianDistr", "mutualInfo", "cramer", "het"):
+        assert counters[name].get("SharedScan", "FusedStages") == 4
+        assert counters[name].get("SharedScan", "Scans") == 1
+        assert counters[name].get("Records", "Processed") == 2000
+
+    pl = plan_mod.plan_pipeline(p)
+    scans = pl.scan_units
+    assert len(scans) == 1 and len(scans[0].stages) == 4
+    assert "fuse" in scans[0].rewrites
+    assert scans[0].staged_scans == 2          # what the hoist saved
+    # the marker stays a staged fallback with its refusal surfaced
+    falls = [u for u in pl.units if isinstance(u, StageUnit)]
+    assert [u.stage.name for u in falls] == ["marker"]
+    assert falls[0].reason == "not a fusable count job"
+
+
+def test_plan_streaming_ragged_chunks_byte_identical(plan_env,
+                                                     staged_outputs):
+    """Planned execution composes with the chunked stream — 700-row chunks
+    leave a ragged 600-row tail — and stays byte-identical."""
+    root, conf, schema = plan_env
+    _, counters = _run_planned(root, conf, schema, "ws_planned_stream",
+                               extra={"stream.chunk.rows": "700"})
+    _assert_bytes(root, "ws_planned_stream", staged_outputs)
+    assert counters["mutualInfo"].get("SharedScan", "Chunks") == 3
+
+
+def test_plan_kernel_routing_byte_identical(plan_env, staged_outputs,
+                                            monkeypatch):
+    """The planned scan on the kernel fast path (forced on, interpret
+    mode) reproduces the staged einsum-path bytes."""
+    root, conf, schema = plan_env
+    monkeypatch.setattr(pallas_hist, "on_tpu_single_device",
+                        lambda *a: True)
+    monkeypatch.setattr(
+        pallas_hist, "cooc_counts",
+        functools.partial(pallas_hist.cooc_counts.__wrapped__,
+                          interpret=True))
+    monkeypatch.setattr(
+        pallas_hist, "gram_moments",
+        functools.partial(pallas_hist.gram_moments.__wrapped__,
+                          interpret=True))
+    _run_planned(root, conf, schema, "ws_planned_kernel",
+                 extra={"stream.chunk.rows": "700"})
+    _assert_bytes(root, "ws_planned_kernel", staged_outputs)
+
+
+# ---------------------------------------------------------------------------
+# share-gram: a uses edge onto a member output joins the unit
+# ---------------------------------------------------------------------------
+
+def test_plan_share_gram_uses_edge(plan_env, staged_outputs):
+    """A ``uses`` edge naming a member's output is ordering-only for a
+    fusable consumer — the stage joins the same unit (share-gram) instead
+    of forcing a second scan after the unit finalizes."""
+    root, conf, schema = plan_env
+
+    def add_uses(p):
+        p.stages[4] = Stage("het", "HeterogeneityReductionCorrelation",
+                            "data", "het_out",
+                            props={"heterogeneity.algorithm": "uncertainty"},
+                            uses=("nb_model",))
+
+    p, _ = _run_planned(root, conf, schema, "ws_planned_uses",
+                        mutate=add_uses)
+    _assert_bytes(root, "ws_planned_uses", staged_outputs)
+    pl = plan_mod.plan_pipeline(p)
+    unit = pl.scan_units[0]
+    assert "share-gram" in unit.rewrites
+    assert [s.name for s in unit.stages] == ["bayesianDistr", "mutualInfo",
+                                             "cramer", "het"]
+
+
+def test_plan_value_dependency_refuses_hoist(plan_env):
+    """An ``@artifact`` property naming a member output is a VALUE
+    dependency — the consumer would read bytes that do not exist until
+    the unit finalizes, so the stage stays staged (ordered after)."""
+    root, conf, schema = plan_env
+    p = _interleaved_pipeline(root / "ws_valdep", JobConfig(dict(conf.props)),
+                              schema.class_field.ordinal)
+    p.stages[4] = Stage("het", "HeterogeneityReductionCorrelation",
+                        "data", "het_out",
+                        props={"heterogeneity.algorithm": "uncertainty",
+                               "some.model.path": "@nb_model"})
+    p.bind("data", str(root / "train.csv"))
+    pl = plan_mod.plan_pipeline(p)
+    unit = pl.scan_units[0]
+    assert "het" not in [s.name for s in unit.stages]
+
+
+# ---------------------------------------------------------------------------
+# prune: dead binned columns dropped from the fold
+# ---------------------------------------------------------------------------
+
+def test_plan_prune_correlation_only_byte_identical(plan_env):
+    """A unit of restricted-attribute correlations folds only the columns
+    any member needs; the narrower gram reproduces the staged bytes
+    (correlation stats slice each pair to true support)."""
+    root, conf, schema = plan_env
+    class_ord = schema.class_field.ordinal
+
+    def corr_pipeline(ws, c):
+        p = Pipeline(str(ws), c)
+        p.add(Stage("cramer", "CramerCorrelation", "data", "cramer_out",
+                    props={"source.attributes": "1,2",
+                           "dest.attributes": str(class_ord)}))
+        p.add(Stage("het", "HeterogeneityReductionCorrelation", "data",
+                    "het_out",
+                    props={"heterogeneity.algorithm": "uncertainty",
+                           "source.attributes": "1",
+                           "dest.attributes": "2"}))
+        p.bind("data", str(root / "train.csv"))
+        return p
+
+    unconf = JobConfig(dict(conf.props))
+    unconf.set("scan.fuse", "false")
+    corr_pipeline(root / "ws_corr_plain", unconf).run()
+
+    pconf = JobConfig(dict(conf.props))
+    pconf.set("plan.on", "true")
+    p = corr_pipeline(root / "ws_corr_planned", pconf)
+    pl = plan_mod.plan_pipeline(p)
+    unit = pl.scan_units[0]
+    assert "prune" in unit.rewrites
+    assert unit.keep is not None and len(unit.keep) < unit.pruned_from
+
+    counters = p.run()
+    for art in ("cramer_out", "het_out"):
+        a = (root / "ws_corr_plain" / art / "part-00000").read_bytes()
+        b = (root / "ws_corr_planned" / art / "part-00000").read_bytes()
+        assert a == b, f"pruned {art} differs"
+    pruned = counters["cramer"].get("SharedScan", "PrunedCols")
+    assert pruned == unit.pruned_from - len(unit.keep) > 0
+
+
+# ---------------------------------------------------------------------------
+# encode-once: units over the same artifact share one EncodedDataset
+# ---------------------------------------------------------------------------
+
+def test_plan_encode_once_across_units(plan_env, staged_outputs):
+    """Two scan units over the same input (split by a compat-breaking
+    scan.pack.on override) share ONE parse+encode through the plan's
+    encode cache; the second unit is marked encode-once and the bytes
+    match the staged run."""
+    root, conf, schema = plan_env
+    class_ord = schema.class_field.ordinal
+
+    def build(ws, c):
+        p = Pipeline(str(ws), c)
+        p.add(Stage("bayesianDistr", "BayesianDistribution", "data",
+                    "nb_model"))
+        p.add(Stage("mutualInfo", "MutualInformation", "data", "mi_out"))
+        p.add(Stage("cramer", "CramerCorrelation", "data", "cramer_out",
+                    props={"dest.attributes": str(class_ord),
+                           "scan.pack.on": "false"}))
+        p.add(Stage("het", "HeterogeneityReductionCorrelation", "data",
+                    "het_out",
+                    props={"heterogeneity.algorithm": "uncertainty",
+                           "scan.pack.on": "false"}))
+        p.bind("data", str(root / "train.csv"))
+        return p
+
+    pconf = JobConfig(dict(conf.props))
+    pconf.set("plan.on", "true")
+    p = build(root / "ws_encode_once", pconf)
+    pl = plan_mod.plan_pipeline(p)
+    scans = pl.scan_units
+    assert len(scans) == 2
+    assert "encode-once" not in scans[0].rewrites
+    assert "encode-once" in scans[1].rewrites
+
+    p.run()
+    _assert_bytes(root, "ws_encode_once", staged_outputs, arts=COUNT_ARTS)
+
+
+# ---------------------------------------------------------------------------
+# pack selection at plan time
+# ---------------------------------------------------------------------------
+
+def test_plan_pack_selection_aot_costed(plan_env):
+    """On this backend both candidates compile and dispatch: the planner
+    decides packed-vs-einsum from a measured sample-chunk dispatch
+    (source \"measured\", explicit pack_on), carries the AOT estimate as
+    the cost record, and the explain line shows both."""
+    root, conf, schema = plan_env
+    pconf = JobConfig(dict(conf.props))
+    pconf.set("plan.on", "true")
+    # single-device routing: the packed-vs-einsum question only exists
+    # off the auto data-parallel mesh (pack requires mesh=None)
+    pconf.set("data.parallel.auto", "false")
+    p = _interleaved_pipeline(root / "ws_pack_probe", pconf,
+                              schema.class_field.ordinal)
+    p.bind("data", str(root / "train.csv"))
+    pl = plan_mod.plan_pipeline(p)
+    unit = pl.scan_units[0]
+    assert unit.pack_source == "measured"
+    assert unit.pack_on in (True, False)
+    assert unit.cost is not None and unit.cost.get("flops", 0) > 0
+    assert unit.cost_rows > 0
+    assert unit.wall_ms is not None and unit.wall_ms > 0
+    assert unit.program
+    assert ("pack" in unit.rewrites) == (unit.pack_on is True)
+    summary = pl.summary()
+    assert summary["source"] == "measured"
+    assert summary["est_flops"] and summary["est_bytes"]
+
+
+def test_plan_pack_opt_out_conf_wins(plan_env, staged_outputs):
+    """scan.pack.on=false beats any planner choice — the fold never packs
+    — and the planned run stays byte-identical."""
+    root, conf, schema = plan_env
+    p, _ = _run_planned(root, conf, schema, "ws_pack_off",
+                        extra={"scan.pack.on": "false"})
+    _assert_bytes(root, "ws_pack_off", staged_outputs)
+    pl = plan_mod.plan_pipeline(p)
+    assert "pack" not in pl.scan_units[0].rewrites
+
+
+# ---------------------------------------------------------------------------
+# singleton demotion + scan-incompatible fallback
+# ---------------------------------------------------------------------------
+
+def test_plan_singleton_stays_staged(plan_env):
+    """One fusable stage with no prune win gains nothing from the scan
+    unit — the planner keeps the standalone job path (same rule as the
+    driver's singleton gate)."""
+    root, conf, schema = plan_env
+    p = Pipeline(str(root / "ws_single"), JobConfig(dict(conf.props)))
+    p.add(Stage("bayesianDistr", "BayesianDistribution", "data", "nb_model"))
+    p.bind("data", str(root / "train.csv"))
+    pl = plan_mod.plan_pipeline(p)
+    assert len(pl.units) == 1 and isinstance(pl.units[0], StageUnit)
+    assert "singleton" in pl.units[0].reason
+
+
+# ---------------------------------------------------------------------------
+# fallback drills: checkpointed / text-mode / multi-process stay staged
+# ---------------------------------------------------------------------------
+
+def test_plan_fallback_drills(plan_env, staged_outputs, monkeypatch,
+                              tmp_path):
+    """Checkpointed streams and text-mode NB keep the staged path with
+    the refusal reason surfaced; a multi-process runtime without a
+    shard.* topology refuses planning-level fusion the same way the
+    driver does."""
+    root, conf, schema = plan_env
+    class_ord = schema.class_field.ordinal
+
+    p = _interleaved_pipeline(root / "ws_fallback",
+                              JobConfig(dict(conf.props)), class_ord)
+    p.stages[2].props["stream.checkpoint.dir"] = str(tmp_path / "ckpt")
+    p.stages[0].props["tabular.input"] = "false"
+    p.bind("data", str(root / "train.csv"))
+    pl = plan_mod.plan_pipeline(p)
+    reasons = {u.stage.name: u.reason for u in pl.units
+               if isinstance(u, StageUnit)}
+    assert reasons["mutualInfo"] == \
+        "checkpointed stream (stream.checkpoint.dir)"
+    assert reasons["bayesianDistr"] == "text-mode NB (tabular.input=false)"
+    # the remaining pair still fuses
+    assert [s.name for s in pl.scan_units[0].stages] == ["cramer", "het"]
+
+    import jax
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    pl2 = plan_mod.plan_pipeline(
+        _interleaved_pipeline(root / "ws_mp", JobConfig(dict(conf.props)),
+                              class_ord).bind(
+            "data", str(root / "train.csv")))
+    assert not pl2.scan_units
+    mp_reasons = {u.reason for u in pl2.units if isinstance(u, StageUnit)}
+    assert "multi-process without a shard.* topology" in mp_reasons
+
+
+def test_plan_fallback_runs_byte_identical(plan_env, staged_outputs,
+                                           tmp_path):
+    """A planned run whose middle stage fell back (checkpointed stream)
+    still produces byte-identical artifacts on every path."""
+    root, conf, schema = plan_env
+
+    def add_ckpt(p):
+        p.stages[2].props["stream.checkpoint.dir"] = \
+            str(tmp_path / "ckpt_run")
+
+    _run_planned(root, conf, schema, "ws_fallback_run", mutate=add_ckpt,
+                 extra={"stream.chunk.rows": "700"})
+    _assert_bytes(root, "ws_fallback_run", staged_outputs)
+
+
+# ---------------------------------------------------------------------------
+# resume under planning
+# ---------------------------------------------------------------------------
+
+def test_plan_resume_skips_satisfied_stages(plan_env, staged_outputs):
+    """Resume-satisfied stages become skip units: journaled per stage,
+    ``Pipeline::skipped`` marked IN PLACE (a partial run's counters
+    survive), satisfied artifacts untouched, the rest planned normally."""
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry.journal import read_events
+
+    root, conf, schema = plan_env
+    ws = "ws_resume"
+    pconf = JobConfig(dict(conf.props))
+    pconf.set("plan.on", "true")
+    pconf.set("trace.on", "true")
+    pconf.set("trace.journal.dir", str(root / "tel_resume"))
+    p = _interleaved_pipeline(root / ws, pconf, schema.class_field.ordinal)
+    p.bind("data", str(root / "train.csv"))
+
+    # simulate a partial earlier run: NB + marker already wrote outputs
+    for art, payload in (("nb_model", staged_outputs["nb_model"]),
+                         ("marker_out", b"marker\n")):
+        os.makedirs(root / ws / art, exist_ok=True)
+        (root / ws / art / "part-00000").write_bytes(payload)
+    nb_before = (root / ws / "nb_model" / "part-00000").stat().st_mtime_ns
+    # partial-run counters that must NOT be clobbered by the skip mark
+    prior = Counters()
+    prior.set("Records", "Processed", 1234)
+    p.counters["bayesianDistr"] = prior
+
+    pl = plan_mod.plan_pipeline(p, resume=True)
+    skips = [u for u in pl.units if isinstance(u, SkipUnit)]
+    assert {u.stage.name for u in skips} == {"bayesianDistr", "marker"}
+    scans = pl.scan_units
+    assert len(scans) == 1
+    assert [s.name for s in scans[0].stages] == ["mutualInfo", "cramer",
+                                                 "het"]
+
+    counters = p.run(resume=True)
+    path = tel.tracer().journal_path
+    tel.tracer().disable()
+
+    _assert_bytes(root, ws, staged_outputs)
+    assert (root / ws / "nb_model" / "part-00000").stat().st_mtime_ns \
+        == nb_before
+    assert counters["bayesianDistr"].get("Pipeline", "skipped") == 1
+    assert counters["bayesianDistr"].get("Records", "Processed") == 1234
+    events = read_events(path)
+    skipped = [e for e in events if e["ev"] == "stage.skipped"]
+    assert {e["stage"] for e in skipped} == {"bayesianDistr", "marker"}
+    compiled = [e for e in events if e["ev"] == "plan.compiled"]
+    assert len(compiled) == 1 and compiled[0]["units"] == 3
+
+
+# ---------------------------------------------------------------------------
+# explain + journal surfaces
+# ---------------------------------------------------------------------------
+
+def test_plan_explain_prints_tree_and_costs(plan_env):
+    root, conf, schema = plan_env
+    p = _interleaved_pipeline(root / "ws_explain",
+                              JobConfig(dict(conf.props)),
+                              schema.class_field.ordinal)
+    p.bind("data", str(root / "train.csv"))
+    text = plan_mod.plan_pipeline(p).explain()
+    assert "PlanGraft: 5 stage(s) -> 2 unit(s)" in text
+    assert "rewrites: fuse" in text
+    assert "staged path ~ 2 scans" in text
+    assert "MFLOP" in text and "sample chunk" in text
+    for name in ("bayesianDistr", "mutualInfo", "cramer", "het"):
+        assert name in text
+    assert "marker" in text and "not a fusable count job" in text
+
+
+def test_plan_sentinel_rows_and_baseline_band():
+    """The e2e bench's nested "planned" block surfaces as planned.* rows
+    (plan_speedup is the banded, canary-free shared-rig ratio — the
+    pack_speedup precedent) and the repo BASELINE.json bands it."""
+    from avenir_tpu.telemetry import sentinel
+
+    line = {
+        "metric": "e2e_csv_nb_mi_pipeline", "value": 1.0e5,
+        "unit": "rows/sec/chip", "value_canary_clean": 1.0e5,
+        "planned": {
+            "plan_speedup": {"value": 2.4, "unit": "x"},
+            "staged_scan_seconds": {"value": 1.9, "unit": "seconds"},
+            "planned_scan_seconds": {"value": 0.8, "unit": "seconds"},
+            "byte_identical": True,          # non-dict: not a metric row
+            "rewrites": ["fuse", "pack"],
+        },
+    }
+    m = sentinel.extract_metrics(line)
+    assert m["planned.plan_speedup"]["value"] == 2.4
+    assert not m["planned.plan_speedup"]["canary_flagged"]
+    assert m["planned.staged_scan_seconds"]["value"] == 1.9
+    assert "planned.byte_identical" not in m
+    assert "planned.rewrites" not in m
+
+    repo_baseline = json.load(open(
+        os.path.join(os.path.dirname(__file__), "..", "BASELINE.json")))
+    assert repo_baseline["planned"]["plan_speedup"]["value"] >= 1.3
+    slow = {**line, "planned": {**line["planned"],
+                                "plan_speedup": {"value": 0.9, "unit": "x"}}}
+    summary = sentinel.evaluate(slow, repo_baseline)
+    assert "planned.plan_speedup" in summary["regressed"]
+    # planned.* rows are glob-optional (the packed.* precedent): a capture
+    # from a bench that never emits them must not fail by omission — but a
+    # PRESENT plan_speedup still compares (and regressed above)
+    other = {"metric": "e2e_csv_nb_mi_pipeline", "value": 1.0e5,
+             "unit": "rows/sec/chip", "value_canary_clean": 1.0e5}
+    verdict = sentinel.evaluate(other, repo_baseline)
+    assert not verdict["missing"]
+    assert "planned.plan_speedup" in verdict["skipped"]
+
+
+def test_plan_summary_schema_matches_journal_event(plan_env):
+    """summary() carries exactly the plan.compiled payload the golden
+    telemetry schema pins (tests/test_telemetry.py)."""
+    root, conf, schema = plan_env
+    p = _interleaved_pipeline(root / "ws_summary",
+                              JobConfig(dict(conf.props)),
+                              schema.class_field.ordinal)
+    p.bind("data", str(root / "train.csv"))
+    summary = plan_mod.plan_pipeline(p).summary()
+    assert set(summary) == {"units", "stages", "fused", "rewrites",
+                            "source", "est_flops", "est_bytes"}
+    assert summary["stages"] == 5 and summary["fused"] == 4

@@ -335,6 +335,13 @@ GOLDEN_EVENT_KEYS = {
                          "waiting", "inflight"},
     "tenant.shed": {"ev", "ts", "trace", "span", "tenant", "quota",
                     "waiting", "inflight", "retry_after_ms"},
+    # PlanGraft (round 19): the planner's one record of what it decided
+    # before anything executed — unit/stage shape, which rewrites fired,
+    # and the summed AOT estimate (null when the backend degraded to
+    # shapes-only) — pipeline/plan.py::journal_plan
+    "plan.compiled": {"ev", "ts", "trace", "span", "units", "stages",
+                      "fused", "rewrites", "source", "est_flops",
+                      "est_bytes"},
 }
 
 # GraftFleet (round 15): EVERY journaled event additionally carries the
@@ -462,6 +469,14 @@ def test_golden_event_shapes(tmp_path):
             with gpool.slot(tenant="g", timeout_s=0):
                 pass
         held.__exit__(None, None, None)
+        # PlanGraft's plan.compiled rides its REAL emission path (the
+        # summary dict is PipelinePlan.summary()'s exact shape)
+        from avenir_tpu.pipeline.plan import journal_plan
+
+        journal_plan({"units": 2, "stages": 5, "fused": 4,
+                      "rewrites": ["fuse", "prune"], "source": "aot",
+                      "est_flops": 1.0e6, "est_bytes": 9.4e5},
+                     tracer=tracer)
     path = tracer.journal_path
     tel.tracer().disable()
     seen = {}
