@@ -24,6 +24,9 @@ class RuleContext:
     relpath: str
     # GL004: key → doc location (None = undocumented); None = load default
     config_keys: Optional[dict] = None
+    # GL011: events documented once-per-run (telemetry/schema.py
+    # EVENT_ONCE); None = load default from the schema file
+    event_once: Optional[frozenset] = None
 
 
 # ---------------------------------------------------------------------------
@@ -428,6 +431,249 @@ def check_gl005(tree: ast.AST, ctx: RuleContext) -> RuleResult:
 
 
 # ---------------------------------------------------------------------------
+# GL009 — thread targets without exception routing
+# ---------------------------------------------------------------------------
+
+_GL009_BROAD = {"Exception", "BaseException"}
+
+
+def _gl009_routes_exceptions(fn: ast.AST) -> bool:
+    """True when the function body contains a broad try/except — the
+    minimum routing discipline for code that runs on its own thread (the
+    handler is expected to push the error into a queue / handshake list /
+    typed shed, which review checks; this rule only catches the
+    nothing-at-all class)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if handler.type is None:
+                return True
+            names = [handler.type] if not isinstance(handler.type,
+                                                     ast.Tuple) \
+                else list(handler.type.elts)
+            for n in names:
+                if isinstance(n, ast.Name) and n.id in _GL009_BROAD:
+                    return True
+    return False
+
+
+def check_gl009(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """``threading.Thread(target=f)`` where ``f`` (resolved in this file)
+    has no broad except anywhere in its body: an exception kills the
+    thread silently and the joiner hangs or loses the failure.  The PR 6
+    ``_handshake_errors`` class — worker threads must route failures into
+    a handshake/queue/typed-shed path the spawner drains.  Test files are
+    exempt (like GL008): a fixture thread that raises fails the test
+    through its joined-state assertions, and pytest owns the report."""
+    from avenir_tpu.analysis.program import _is_test_file
+    if _is_test_file(ctx.relpath):
+        return []
+    _attach_parents(tree)
+    # symbol table: module functions + methods, by simple name
+    defs: Dict[str, ast.AST] = {}
+    methods: Dict[Tuple[str, str], ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+            for anc in _ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    methods[(anc.name, node.name)] = node
+                    break
+    out: RuleResult = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if (_dotted(node.func) or "").split(".")[-1] != "Thread":
+            continue
+        target = next((kw.value for kw in node.keywords
+                       if kw.arg == "target"), None)
+        if target is None:
+            continue
+        dotted = _dotted(target)
+        fn = None
+        if dotted is None:
+            continue                         # lambda / call result: skip
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            fn = defs.get(parts[0])
+        elif parts[0] in ("self", "cls") and len(parts) == 2:
+            for anc in _ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    fn = methods.get((anc.name, parts[1]))
+                    break
+        if fn is None:
+            continue                         # cross-object target: skip
+        if not _gl009_routes_exceptions(fn):
+            out.append((node.lineno, (
+                f"thread target {dotted}() has no broad except — an "
+                f"uncaught exception kills the thread silently and the "
+                f"joiner hangs or loses the failure; route errors into a "
+                f"handshake/queue/typed-shed path the spawner drains "
+                f"(jobs/base.py::_handshake_errors pattern)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL010 — bare ValueError/RuntimeError on conf-contract paths
+# ---------------------------------------------------------------------------
+
+_GL010_BARE = {"ValueError", "RuntimeError"}
+_GL010_KEY_RE = re.compile(r"[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+")
+
+
+def _gl010_message_literals(exc: ast.Call) -> str:
+    """The constant text of the exception message (plain string or the
+    literal parts of an f-string)."""
+    if not exc.args:
+        return ""
+    arg = exc.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        return "".join(v.value for v in arg.values
+                       if isinstance(v, ast.Constant)
+                       and isinstance(v.value, str))
+    return ""
+
+
+def check_gl010(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """``raise ValueError/RuntimeError`` on a conf-contract path — the
+    config error contract (core/config.py::ConfigError, PR 7's
+    ``shard.devices`` fix) demands the typed error so callers and the CLI
+    can distinguish bad configuration from internal failures.  Fires when
+    the message names a registered config key, or when the raise is
+    guarded by an ``if`` over a value read from ``conf.get*()`` in the
+    same function."""
+    registry = ctx.config_keys if ctx.config_keys is not None \
+        else _default_config_keys()
+    _attach_parents(tree)
+    out: RuleResult = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise) or \
+                not isinstance(node.exc, ast.Call) or \
+                not isinstance(node.exc.func, ast.Name) or \
+                node.exc.func.id not in _GL010_BARE:
+            continue
+        kind = node.exc.func.id
+        message = _gl010_message_literals(node.exc)
+        named_keys = [t for t in _GL010_KEY_RE.findall(message)
+                      if t in registry]
+        conf_guarded = False
+        fn = _enclosing_function(node)
+        if fn is not None and not named_keys:
+            tainted = set()
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign) and \
+                        isinstance(n.value, ast.Call) and \
+                        isinstance(n.value.func, ast.Attribute) and \
+                        n.value.func.attr in _CONF_GETTERS:
+                    dotted = _dotted(n.value.func) or ""
+                    receiver = dotted.rsplit(".", 1)[0].split(".")[-1]
+                    if "conf" in receiver.lower() or \
+                            "cfg" in receiver.lower():
+                        for tgt in n.targets:
+                            for t in ast.walk(tgt):
+                                if isinstance(t, ast.Name):
+                                    tainted.add(t.id)
+            for anc in _ancestors(node):
+                if anc is fn:
+                    break
+                if isinstance(anc, ast.If) and any(
+                        isinstance(t, ast.Name) and t.id in tainted
+                        for t in ast.walk(anc.test)):
+                    conf_guarded = True
+                    break
+        if named_keys or conf_guarded:
+            what = (f"names config key {named_keys[0]!r}" if named_keys
+                    else "is guarded by a conf.get*() value")
+            out.append((node.lineno, (
+                f"bare {kind} on a conf-contract path ({what}) — raise "
+                f"ConfigError (core/config.py) instead so callers and "
+                f"the CLI can tell bad configuration from internal "
+                f"failures (the PR 7 shard.devices class); ConfigError "
+                f"subclasses ValueError, so existing callers keep "
+                f"working")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL011 — once-per-run events emitted without the latch
+# ---------------------------------------------------------------------------
+
+def _default_event_once() -> frozenset:
+    from avenir_tpu.analysis.program import load_event_schema
+    schema = load_event_schema()
+    return frozenset(schema.once) if schema is not None else frozenset()
+
+
+def check_gl011(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """A once-per-run event (telemetry/schema.py EVENT_ONCE) emitted via
+    plain ``.event()`` instead of ``event_once``/a latch: restarts,
+    retries, and per-chunk paths spam duplicates of records every
+    consumer treats as unique (the shard.topology/fleet.join/
+    tenant.admitted contract)."""
+    once = ctx.event_once if ctx.event_once is not None \
+        else _default_event_once()
+    if not once:
+        return []
+    from avenir_tpu.analysis.program import _emit_site
+    out: RuleResult = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        site = _emit_site(node)
+        if site is not None and site[0] == "event" and site[1] in once:
+            out.append((node.lineno, (
+                f"once-per-run event {site[1]!r} emitted with plain "
+                f".event() — use tracer.event_once(..., key=...) (or an "
+                f"equivalent latch) so restarts and per-chunk paths "
+                f"can't journal duplicates")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL012 — silently swallowed broad excepts
+# ---------------------------------------------------------------------------
+
+def check_gl012(tree: ast.AST, ctx: RuleContext) -> RuleResult:
+    """``except Exception:`` (or bare ``except:``) whose body is nothing
+    but ``pass``/``continue``/``break`` — the failure leaves no trace:
+    no re-raise, no counter, no journal event.  Exempt when the ``try``
+    body imports (optional-dependency probes are the one legitimate
+    silent catch).  The review class behind PR 14's swallowed journal
+    errors: a silent except turns a real failure into a debugging
+    session."""
+    _attach_parents(tree)
+    out: RuleResult = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        probes_import = any(isinstance(n, (ast.Import, ast.ImportFrom))
+                            for stmt in node.body
+                            for n in ast.walk(stmt))
+        if probes_import:
+            continue
+        for handler in node.handlers:
+            broad = handler.type is None or (
+                isinstance(handler.type, ast.Name)
+                and handler.type.id in ("Exception", "BaseException"))
+            if not broad:
+                continue
+            if all(isinstance(s, (ast.Pass, ast.Continue, ast.Break))
+                   for s in handler.body):
+                out.append((handler.lineno, (
+                    f"except "
+                    f"{'Exception' if handler.type is not None else ''}"
+                    f" swallows silently — no re-raise, counter, or "
+                    f"journal event survives the failure; record it "
+                    f"(Counters / tracer.event) or re-raise, and if the "
+                    f"silence is designed, say why on a graftlint "
+                    f"disable comment")))
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 RULES: Dict[str, Callable[[ast.AST, RuleContext], RuleResult]] = {
     "GL001": check_gl001,
@@ -435,4 +681,8 @@ RULES: Dict[str, Callable[[ast.AST, RuleContext], RuleResult]] = {
     "GL003": check_gl003,
     "GL004": check_gl004,
     "GL005": check_gl005,
+    "GL009": check_gl009,
+    "GL010": check_gl010,
+    "GL011": check_gl011,
+    "GL012": check_gl012,
 }

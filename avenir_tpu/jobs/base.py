@@ -193,7 +193,7 @@ class Job:
     def load_schema(conf: JobConfig) -> FeatureSchema:
         path = conf.get("feature.schema.file.path")
         if not path:
-            raise ValueError("feature.schema.file.path not set")
+            raise ConfigError("feature.schema.file.path not set")
         return FeatureSchema.from_file(path)
 
     @staticmethod

@@ -9,7 +9,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import naive_bayes as nb
 from avenir_tpu.utils.metrics import Counters
@@ -167,7 +167,7 @@ class BayesianPredictor(Job):
         delim = conf.field_delim
         model_path = conf.get("bayesian.model.file.path")
         if not model_path:
-            raise ValueError("bayesian.model.file.path not set")
+            raise ConfigError("bayesian.model.file.path not set")
         if not conf.get_bool("tabular.input", True):
             self._predict_text(conf, input_path, output_path, counters)
             return

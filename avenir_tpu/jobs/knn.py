@@ -22,7 +22,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import knn as mknn
 from avenir_tpu.models import naive_bayes as nb
@@ -32,7 +32,7 @@ from avenir_tpu.utils.metrics import Counters
 def _train_model(conf: JobConfig, enc=None, need_rows: bool = True):
     train_path = conf.get("training.data.path")
     if not train_path:
-        raise ValueError("training.data.path not set")
+        raise ConfigError("training.data.path not set")
     return Job.encode_input(conf, train_path, encoder=enc,
                             need_rows=need_rows)
 
@@ -78,7 +78,7 @@ class FeatureCondProbJoiner(Job):
         delim = conf.field_delim
         prob_path = conf.get("feature.prob.file.path")
         if not prob_path:
-            raise ValueError("feature.prob.file.path not set")
+            raise ConfigError("feature.prob.file.path not set")
         probs: Dict[str, List[str]] = {}
         for ln in read_lines(prob_path):
             rid, cv, p = ln.split(delim)
@@ -128,7 +128,7 @@ class NearestNeighbor(Job):
         if class_cond:
             model_path = conf.get("bayesian.model.file.path")
             if not model_path:
-                raise ValueError("class-conditional weighting requires "
+                raise ConfigError("class-conditional weighting requires "
                                  "bayesian.model.file.path")
             bayes = nb.model_from_lines(read_lines(model_path), enc, delim=delim)
             class_probs = nb.NaiveBayes().predict(bayes, train_ds).probs
@@ -151,7 +151,7 @@ class NearestNeighbor(Job):
         if regression:
             target_ord = conf.get_int("regression.target.ordinal")
             if target_ord is None:
-                raise ValueError("regression mode requires regression.target.ordinal")
+                raise ConfigError("regression mode requires regression.target.ordinal")
             values = train_rows[:, target_ord].astype(np.float64)
             model = est.fit(train_ds, values=values)
             method = conf.get("regression.method", "average")
@@ -159,7 +159,7 @@ class NearestNeighbor(Job):
             if method == "linear":
                 in_ord = conf.get_int("regression.input.var.ordinal")
                 if in_ord is None:
-                    raise ValueError("regression.method=linear requires "
+                    raise ConfigError("regression.method=linear requires "
                                      "regression.input.var.ordinal")
                 kwargs = dict(
                     input_var=np.asarray([r[in_ord] for r in test_rows], np.float64),

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import List
 
-from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import markov as mk
 from avenir_tpu.utils.metrics import Counters
@@ -136,7 +136,7 @@ class HiddenMarkovModelBuilder(Job):
         obs_enc = mk.SequenceEncoder(obs_vocab) if obs_vocab else None
         partial = conf.get_bool("partially.tagged", False)
         if partial and not states:
-            raise ValueError("partially.tagged mode requires model.states")
+            raise ConfigError("partially.tagged mode requires model.states")
         window = conf.get_float_list("window.function", [1.0, 0.75, 0.5, 0.25])
         if conf.get("stream.chunk.rows"):
             # streaming/multi-process path (HiddenMarkovModelBuilder.java
@@ -187,7 +187,7 @@ class ViterbiStatePredictor(Job):
         delim = conf.field_delim_regex
         model_path = conf.get("hmm.model.file.path") or conf.get("model.file.path")
         if not model_path:
-            raise ValueError("hmm.model.file.path not set")
+            raise ConfigError("hmm.model.file.path not set")
         model = mk.HMMModel.from_lines(read_lines(model_path),
                                        delim=conf.field_delim)
         pair_output = not conf.get_bool("output.state.only", True)

@@ -24,7 +24,7 @@ from typing import List
 
 import numpy as np
 
-from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.jobs.base import Job, read_lines, write_output
 from avenir_tpu.models import tree as dtree
 from avenir_tpu.utils.metrics import ConfusionMatrix, Counters
@@ -307,7 +307,7 @@ class DecisionTreeBuilder(Job):
                                            with_labels=validation,
                                            encoder=enc)
         if validation and ds.labels is None:
-            raise ValueError("prediction.mode=validation requires labeled "
+            raise ConfigError("prediction.mode=validation requires labeled "
                              "input (class column missing)")
         walk = dtree.predict_fn(model)
         pred, _distr = walk(jnp.asarray(ds.codes))

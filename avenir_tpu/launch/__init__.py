@@ -234,12 +234,17 @@ def launch_local(child_argv: Sequence[str], nprocs: int, *,
     lock = threading.Lock()
 
     def pump(rank: int) -> None:
-        for line in procs[rank].stdout:
-            outputs[rank].append(line)
-            if echo:
-                with lock:
-                    sys.stdout.write(f"[p{rank}] {line}")
-                    sys.stdout.flush()
+        try:
+            for line in procs[rank].stdout:
+                outputs[rank].append(line)
+                if echo:
+                    with lock:
+                        sys.stdout.write(f"[p{rank}] {line}")
+                        sys.stdout.flush()
+        except Exception as e:            # noqa: BLE001
+            # route into the captured transcript the supervisor reports —
+            # a dead reader must not silently truncate a worker's output
+            outputs[rank].append(f"[launcher] output pump died: {e!r}\n")
 
     readers = [threading.Thread(target=pump, args=(r,), daemon=True)
                for r in range(nprocs)]

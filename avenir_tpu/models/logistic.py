@@ -230,7 +230,8 @@ class LogisticRegression:
                 # per-iteration fold sums them in sorted() order — an index
                 # past the width would silently reorder the f64 addition
                 # sequence and break the byte-identity contract (GL003)
-                raise ValueError(
+                from avenir_tpu.core.config import ConfigError
+                raise ConfigError(
                     f"chunk index {idx} exceeds the 8-digit gradient-key "
                     f"width; raise stream.chunk.rows")
         dev = [(idx, jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32))

@@ -172,13 +172,19 @@ class DeviceSkewProbe:
             f"{getattr(sh.device, 'id', i)}"
             for i, sh in enumerate(shards)]
         times = [0.0] * len(shards)
+        errors: List[BaseException] = []
 
         def wait(i: int, data) -> None:
             # block_until_ready releases the GIL: each thread observes
             # ITS device's completion independently — sequential blocking
             # would mask any straggler ordered before a fast device
-            data.block_until_ready()
-            times[i] = (time.perf_counter() - t0) * 1e3
+            try:
+                data.block_until_ready()
+                times[i] = (time.perf_counter() - t0) * 1e3
+            except Exception as e:        # noqa: BLE001
+                # a failed device must not report 0 ms (it would read as
+                # the FASTEST shard) — surface it after the join barrier
+                errors.append(e)
 
         threads = [threading.Thread(target=wait, args=(i, sh.data),
                                     daemon=True)
@@ -187,6 +193,8 @@ class DeviceSkewProbe:
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise errors[0]
         return publish_skew(times, chunk=n, threshold=self.threshold,
                             device_labels=labels_now,
                             counters=self.counters,

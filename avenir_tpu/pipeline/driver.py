@@ -18,7 +18,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
-from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.config import ConfigError, JobConfig
 from avenir_tpu.utils.metrics import Counters
 
 
@@ -80,7 +80,7 @@ class Pipeline:
         - ``pipeline.bind.<artifact>`` — external path bindings."""
         names = conf.get_list("pipeline.stages")
         if not names:
-            raise ValueError(
+            raise ConfigError(
                 "pipeline.stages must list the stage names in execution "
                 "order (see docs/jobs.md, 'Conf-declared pipelines')")
         ws = workspace or conf.get("pipeline.workspace") or "pipeline_ws"
@@ -95,7 +95,7 @@ class Pipeline:
             inp = conf.get(pref + "input")
             out = conf.get(pref + "output")
             if not (job and inp and out):
-                raise ValueError(
+                raise ConfigError(
                     f"stage {name!r} needs {pref}job, {pref}input and "
                     f"{pref}output")
             prop_pref = pref + "prop."

@@ -405,11 +405,14 @@ class BucketedMicrobatcher:
                         self.heartbeat = time.monotonic()
                         try:
                             self._dispatch(name, reqs)
-                        except InjectedFault:
-                            # serve.dispatch kill — replica-fatal: every
-                            # unfinished request (this batch + everything
-                            # queued) fails RETRYABLE so the pool can
-                            # re-enqueue it on a survivor
+                        except Exception:  # noqa: BLE001
+                            # replica-fatal, injected (serve.dispatch
+                            # kill) or real: every unfinished request
+                            # (this batch + everything queued) fails
+                            # RETRYABLE so the pool can re-enqueue it on
+                            # a survivor — waiting for the heartbeat
+                            # deadline to reap a silently-dead loop
+                            # would stall them for seconds instead
                             self._die([r for _, rs in batches[i:]
                                        for r in rs])
                             return

@@ -446,7 +446,14 @@ class ReplicaPool:
             self.autoscale_once()
 
     def _probe_replica(self, r: Replica) -> None:
-        if r.batcher.probe(self.probe_timeout_s):
+        try:
+            alive = r.batcher.probe(self.probe_timeout_s)
+        except Exception:                 # noqa: BLE001
+            # a raising probe must route to the failure branch: a dead
+            # thread here would strand the replica HALF_OPEN forever
+            # (ticks only probe while the breaker reads OPEN)
+            alive = False
+        if alive:
             with self._lock:
                 r.breaker = CLOSED
                 r.consecutive = 0

@@ -280,14 +280,22 @@ def run_soak(batch_rounds=3, steady_panes=10, drifted_panes=8,
             if flood:
                 lock = threading.Lock()
 
+                rogue_errors: list = []
+
                 def flood_loop():
-                    with tenancy.tenant_scope("noisy"):
-                        for _ in range(noisy_flood_iters):
-                            try:
-                                one_fold()
-                            except TenantShedError:
-                                with lock:
-                                    sheds[0] += 1
+                    try:
+                        with tenancy.tenant_scope("noisy"):
+                            for _ in range(noisy_flood_iters):
+                                try:
+                                    one_fold()
+                                except TenantShedError:
+                                    with lock:
+                                        sheds[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        # a crashed rogue must show in the report, not
+                        # silently undercount the flood pressure
+                        with lock:
+                            rogue_errors.append(repr(e))
                 rogues = [threading.Thread(target=flood_loop)
                           for _ in range(noisy_flood_workers)]
                 for t in rogues:
@@ -296,6 +304,8 @@ def run_soak(batch_rounds=3, steady_panes=10, drifted_panes=8,
                     t.join(120.0)
             results["noisy_flooded"] = flood
             results["noisy_client_sheds"] = sheds[0]
+            if flood and rogue_errors:
+                results["noisy_rogue_errors"] = rogue_errors
 
     workers = [threading.Thread(target=fn, name=name) for name, fn in (
         ("soak-batch", batch_worker), ("soak-stream", stream_worker),

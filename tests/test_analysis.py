@@ -17,6 +17,7 @@ import sys
 import pytest
 
 from avenir_tpu.analysis import engine
+from avenir_tpu.analysis import program
 from avenir_tpu.analysis import registry_gen
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
@@ -143,6 +144,127 @@ def fold(chunks):
 """
 
 
+GL006_POS_DIRECT = """\
+import threading
+
+_lock = threading.Lock()
+
+def flush(path, rows):
+    with _lock:
+        with open(path, "a") as fh:       # file I/O under a held lock
+            fh.write(str(rows))
+"""
+
+GL006_NEG_DEFERRED = """\
+import threading
+
+_lock = threading.Lock()
+
+def flush(path, rows):
+    fires = []
+    with _lock:
+        fires.append(("tenant.throttled", {"rows": rows}))
+    with open(path, "a") as fh:           # I/O after the release
+        fh.write(str(rows))
+"""
+
+GL006_NEG_FILELOCK = """\
+from avenir_tpu.utils.locking import FileLock
+
+def flush(path):
+    lock = FileLock(path + ".lock")
+    with lock:                            # cross-process file lock, not a
+        with open(path, "a") as fh:       # threading lock — I/O is its job
+            fh.write("x")
+"""
+
+GL009_POS = """\
+import threading
+
+def work(results):
+    results.append(1 / 0)
+
+def spawn(results):
+    t = threading.Thread(target=work, args=(results,), daemon=True)
+    t.start()
+    return t
+"""
+
+GL009_NEG_ROUTED = """\
+import threading
+
+def work(results, errors):
+    try:
+        results.append(1 / 0)
+    except Exception as e:
+        errors.append(e)                  # routed: the spawner drains it
+
+def spawn(results, errors):
+    t = threading.Thread(target=work, args=(results, errors), daemon=True)
+    t.start()
+    return t
+"""
+
+GL010_POS_GUARDED = """\
+def run(conf):
+    path = conf.get("some.key")
+    if not path:
+        raise ValueError("missing input location")
+"""
+
+GL010_NEG_TYPED = """\
+from avenir_tpu.core.config import ConfigError
+
+def run(conf):
+    path = conf.get("some.key")
+    if not path:
+        raise ConfigError("missing input location")
+"""
+
+GL010_NEG_INTERNAL = """\
+def check(x):
+    if x < 0:
+        raise ValueError("negative input")   # not a conf-contract path
+"""
+
+GL011_POS = """\
+def announce(tracer, devices):
+    tracer.event("shard.topology", devices=devices)
+"""
+
+GL011_NEG = """\
+def announce(tracer, devices):
+    tracer.event_once("shard.topology", devices=devices)
+"""
+
+GL012_POS = """\
+def cleanup(sock):
+    try:
+        sock.close()
+    except Exception:
+        pass
+"""
+
+GL012_NEG_RERAISE = """\
+def cleanup(sock):
+    try:
+        sock.close()
+    except Exception:
+        raise
+"""
+
+GL012_NEG_IMPORT_PROBE = """\
+def maybe_accel():
+    try:
+        import jax
+    except Exception:
+        pass                              # optional-dependency probe
+    else:
+        return jax
+    return None
+"""
+
+
 def lint_src(tmp_path, src, config_keys=None, name="snippet.py",
              baseline_path=None):
     f = tmp_path / name
@@ -167,6 +289,19 @@ FIXTURES = [
     ("GL005", True, GL005_POS_DEVICE_GET),
     ("GL005", False, GL005_NEG_OUTSIDE),
     ("GL005", False, GL005_NEG_ON_HOST),
+    ("GL006", True, GL006_POS_DIRECT),
+    ("GL006", False, GL006_NEG_DEFERRED),
+    ("GL006", False, GL006_NEG_FILELOCK),
+    ("GL009", True, GL009_POS),
+    ("GL009", False, GL009_NEG_ROUTED),
+    ("GL010", True, GL010_POS_GUARDED),
+    ("GL010", False, GL010_NEG_TYPED),
+    ("GL010", False, GL010_NEG_INTERNAL),
+    ("GL011", True, GL011_POS),
+    ("GL011", False, GL011_NEG),
+    ("GL012", True, GL012_POS),
+    ("GL012", False, GL012_NEG_RERAISE),
+    ("GL012", False, GL012_NEG_IMPORT_PROBE),
 ]
 
 
@@ -320,6 +455,202 @@ def test_syntax_error_reports_gl000(tmp_path):
     assert [f.rule for f in findings] == ["GL000"]
 
 
+# -- the whole-program pass (GL006/GL007/GL008) ---------------------------
+
+def _mini_schema(tmp_path, events=("known.event",), once=()):
+    p = tmp_path / "mini_schema.py"
+    p.write_text(
+        "GOLDEN_EVENT_KEYS = {\n"
+        + "".join(f'    "{e}": ("ev", "ts"),\n' for e in events)
+        + "}\n"
+        + f"EVENT_ONCE = {set(once)!r}\n")
+    return program.load_event_schema(str(p), explicit=True)
+
+
+def test_gl006_cross_file_reachability(tmp_path):
+    """The tentpole case GL006 exists for: the I/O sits in ANOTHER module,
+    reached transitively from inside the held region."""
+    (tmp_path / "iohelp.py").write_text(
+        "def persist(path):\n"
+        "    with open(path, 'a') as fh:\n"
+        "        fh.write('x')\n")
+    (tmp_path / "hot.py").write_text(
+        "import threading\n"
+        "from iohelp import persist\n"
+        "\n"
+        "_lock = threading.Lock()\n"
+        "\n"
+        "def flush(path):\n"
+        "    with _lock:\n"
+        "        persist(path)\n")
+    findings = engine.run_paths([str(tmp_path)], root=str(tmp_path),
+                                baseline_path=None, config_keys={})
+    gl6 = [f for f in findings if f.rule == "GL006"]
+    assert [f.path for f in gl6] == ["hot.py"], \
+        "\n".join(f.format() for f in findings)
+    assert "iohelp.py::persist" in gl6[0].message
+
+
+def test_gl007_unknown_event_and_liveness(tmp_path):
+    schema = _mini_schema(tmp_path, events=("known.event",))
+    (tmp_path / "emit.py").write_text(
+        'def go(tracer):\n'
+        '    tracer.event("zorp.mystery", x=1)\n')
+    findings = engine.run_paths([str(tmp_path / "emit.py")],
+                                root=str(tmp_path), baseline_path=None,
+                                config_keys={}, event_schema=schema)
+    gl7 = [f for f in findings if f.rule == "GL007"]
+    assert any("'zorp.mystery'" in f.message and f.path == "emit.py"
+               for f in gl7), "\n".join(f.format() for f in gl7)
+    assert any("'known.event'" in f.message and "no live emit site"
+               in f.message for f in gl7)
+
+
+def test_gl007_literal_emit_and_deferred_tuple_both_count_live(tmp_path):
+    """A deferred-fire tuple (the arbiter's fires-list pattern) satisfies
+    the liveness direction without ever being treated as a literal emit —
+    so config-key tuples can't trip the unknown-name direction."""
+    schema = _mini_schema(tmp_path, events=("known.event",))
+    (tmp_path / "emit.py").write_text(
+        'def go(tracer, fires):\n'
+        '    fires.append(("known.event", {"x": 1}))\n')
+    findings = engine.run_paths([str(tmp_path / "emit.py")],
+                                root=str(tmp_path), baseline_path=None,
+                                config_keys={}, event_schema=schema)
+    assert not [f for f in findings if f.rule == "GL007"], \
+        "\n".join(f.format() for f in findings)
+
+
+def test_gl007_seeded_schema_drift_fires_on_real_tree(tmp_path):
+    """The acceptance drill: mutate a copy of the golden schema (rename
+    span.open → span.opened) and prove the cross-file pass catches BOTH
+    drift directions over the live tree — the real emit site becomes
+    unknown, the renamed schema entry goes dead."""
+    real = (REPO / "avenir_tpu" / "telemetry" / "schema.py").read_text()
+    assert real.count('"span.open"') == 1
+    mutated = tmp_path / "mutated_schema.py"
+    mutated.write_text(real.replace('"span.open"', '"span.opened"'))
+    schema = program.load_event_schema(str(mutated), explicit=True)
+    tree = [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
+            str(REPO / "bench.py")]
+    gl7 = [f for f in engine.run_paths(tree, root=str(REPO),
+                                       baseline_path=None,
+                                       rules={"GL007": None},
+                                       event_schema=schema)
+           if f.rule == "GL007"]
+    assert any("'span.open'" in f.message
+               and f.path == "avenir_tpu/telemetry/spans.py"
+               for f in gl7), "\n".join(f.format() for f in gl7)
+    assert any("'span.opened'" in f.message and "no live emit site"
+               in f.message for f in gl7)
+    # control: the unmutated schema, same explicit liveness mode, is clean
+    clean = program.load_event_schema(
+        str(REPO / "avenir_tpu" / "telemetry" / "schema.py"),
+        explicit=True)
+    assert not [f for f in engine.run_paths(tree, root=str(REPO),
+                                            baseline_path=None,
+                                            rules={"GL007": None},
+                                            event_schema=clean)
+                if f.rule == "GL007"]
+
+
+def test_gl008_unknown_undocumented_and_wildcard(tmp_path):
+    src = (
+        "def count(counters, model):\n"
+        '    counters.increment("Zorp", "n")\n'
+        '    counters.increment(f"Serving.{model}", "n")\n')
+    (tmp_path / "mod.py").write_text(src)
+
+    def run(reg):
+        return [f for f in engine.run_paths(
+            [str(tmp_path / "mod.py")], root=str(tmp_path),
+            baseline_path=None, config_keys={}, counter_registry=reg)
+            if f.rule == "GL008"]
+
+    both = run({"groups": {}, "spans": {}})
+    assert len(both) == 2                   # Zorp + Serving.* both unknown
+    undoc = run({"groups": {"Zorp": None, "Serving.*": "docs/a.md"},
+                 "spans": {}})
+    assert len(undoc) == 1 and "Zorp" in undoc[0].message
+    clean = run({"groups": {"Zorp": "docs/a.md", "Serving.*": "docs/a.md"},
+                 "spans": {}})
+    assert not clean
+    # test files are exempt — fixture groups are deliberate
+    (tmp_path / "test_mod.py").write_text(src)
+    assert not [f for f in engine.run_paths(
+        [str(tmp_path / "test_mod.py")], root=str(tmp_path),
+        baseline_path=None, config_keys={},
+        counter_registry={"groups": {}, "spans": {}})
+        if f.rule == "GL008"]
+
+
+def test_counter_registry_matches_tree():
+    """Same staleness contract as the config registry: the checked-in
+    counter/span registry is exactly what a regeneration produces, and
+    nothing in it is undocumented."""
+    from avenir_tpu.analysis.counter_registry import (COUNTER_GROUPS,
+                                                      SPAN_SITES)
+    groups, spans = registry_gen.scan_counter_span_sites(
+        [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
+         str(REPO / "bench.py")])
+    assert sorted(groups) == sorted(COUNTER_GROUPS) and \
+        sorted(spans) == sorted(SPAN_SITES), (
+        "counter_registry.py is stale — run "
+        "`python -m avenir_tpu.analysis --write-registry`")
+    undocumented = sorted(k for k, v in {**COUNTER_GROUPS,
+                                         **SPAN_SITES}.items() if v is None)
+    assert not undocumented, (
+        f"undocumented counter groups / spans: {undocumented} — document "
+        f"them (docs/observability.md has the group table) and regenerate")
+
+
+# -- facts cache + incremental (--changed) mechanics ----------------------
+
+def test_cache_warm_hits_and_salt_invalidation(tmp_path):
+    (tmp_path / "a.py").write_text(GL003_NEG)
+    (tmp_path / "b.py").write_text(GL003_NEG)
+    cache = tmp_path / "cache.json"
+
+    def run(config_keys={}):
+        stats: dict = {}
+        findings = engine.run_paths(
+            [str(tmp_path / "a.py"), str(tmp_path / "b.py")],
+            root=str(tmp_path), baseline_path=None,
+            config_keys=config_keys, cache_path=str(cache), stats=stats)
+        return findings, stats
+
+    _, cold = run()
+    assert cold["files"] == 2 and cold["cache_hits"] == 0
+    _, warm = run()
+    assert warm["cache_hits"] == 2
+    # a different rule-parameter fingerprint must invalidate the cache
+    _, salted = run(config_keys={"some.key": "docs/x.md"})
+    assert salted["cache_hits"] == 0
+
+
+def test_changed_set_trusts_git_over_disk(tmp_path):
+    """--changed semantics: a cached file NOT in the changed set is reused
+    without re-reading — mutations git doesn't report are invisible until
+    the file enters the changed set (or the cache is dropped)."""
+    b = tmp_path / "b.py"
+    (tmp_path / "a.py").write_text(GL003_NEG)
+    b.write_text(GL003_NEG)
+    cache = tmp_path / "cache.json"
+
+    def run(changed=None):
+        return engine.run_paths(
+            [str(tmp_path / "a.py"), str(b)], root=str(tmp_path),
+            baseline_path=None, config_keys={}, cache_path=str(cache),
+            changed=changed)
+
+    assert not [f for f in run() if f.rule == "GL003"]
+    b.write_text(GL003_POS)               # now violating, on disk only
+    assert not [f for f in run(changed=set()) if f.rule == "GL003"], \
+        "a file outside the changed set must be served from cache unread"
+    hot = [f for f in run(changed={"b.py"}) if f.rule == "GL003"]
+    assert [f.path for f in hot] == ["b.py"]
+
+
 # -- CLI contract ---------------------------------------------------------
 
 def _run_cli(args, cwd):
@@ -346,6 +677,35 @@ def test_cli_clean_exits_zero(tmp_path):
     (tmp_path / "ok.py").write_text(GL003_NEG)
     res = _run_cli(["ok.py"], cwd=str(tmp_path))
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_stats_and_cache(tmp_path):
+    (tmp_path / "ok.py").write_text(GL003_NEG)
+    cold = _run_cli(["ok.py", "--stats"], cwd=str(tmp_path))
+    assert cold.returncode == 0
+    assert "graftlint stats: 1 files" in cold.stderr
+    assert "0 cache hits" in cold.stderr
+    warm = _run_cli(["ok.py", "--stats"], cwd=str(tmp_path))
+    assert "1 cache hits" in warm.stderr
+    uncached = _run_cli(["ok.py", "--stats", "--no-cache"],
+                        cwd=str(tmp_path))
+    assert "0 cache hits" in uncached.stderr
+
+
+def test_cli_changed_outside_git_falls_back_to_full_run(tmp_path):
+    # tmp_path is no git worktree: --changed must degrade to a full run,
+    # not crash or silently lint nothing
+    (tmp_path / "bad.py").write_text(GL003_POS)
+    res = _run_cli(["bad.py", "--changed", "--no-baseline"],
+                   cwd=str(tmp_path))
+    assert res.returncode == 1
+    assert "GL003" in res.stdout
+
+
+def test_cli_check_registry_up_to_date():
+    res = _run_cli(["--check-registry"], cwd=str(REPO))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "registries up to date" in res.stdout
 
 
 # -- the live gate: the whole tree, as CI ---------------------------------
@@ -403,6 +763,12 @@ def test_whole_tree_zero_nonbaselined_findings():
     # undocumented plan.*/pipeline.* key (GL004) or a sync-in-loop around
     # the measured-dispatch cost probes (GL005) would hide
     # (pipeline/plan.py itself sits inside the avenir_tpu tree)
+    # round 20 (graftlint v2): the same walk now also runs the whole-
+    # program rules — I/O under held locks (GL006), golden-schema event
+    # drift in both directions (GL007, liveness included because
+    # telemetry/schema.py sits inside the walked tree), counter/span
+    # registry drift (GL008) — plus the new local rules GL009–GL012;
+    # designed exceptions live in baseline.json, each with a why
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),

@@ -240,115 +240,15 @@ def test_journal_rotation_bounds_growth(tmp_path):
 # golden event schema — the journal's shape is tier-1-stable
 # ---------------------------------------------------------------------------
 
-GOLDEN_EVENT_KEYS = {
-    "span.open": {"ev", "ts", "trace", "span", "parent", "name", "attrs"},
-    "span.close": {"ev", "ts", "trace", "span", "name", "dur_ms", "status",
-                   "attrs"},
-    "counters": {"ev", "ts", "trace", "span", "scope", "groups"},
-    "gauge": {"ev", "ts", "trace", "span", "name", "value"},
-    "recompile": {"ev", "ts", "trace", "span", "scope", "keys"},
-    "checkpoint.save": {"ev", "ts", "trace", "span", "dir", "run", "rows",
-                        "chunk"},
-    # GraftFleet (round 15): per-device straggler probes
-    # (parallel/skew.py — flagged when max/min exceeds the threshold),
-    # cross-process collective-wait attribution (parallel/mesh.py), and
-    # the SLO evaluator's transition-into-violation record
-    # (telemetry/slo.py) — docs/observability.md event table
-    "shard.skew": {"ev", "ts", "trace", "span", "chunk", "device_ms",
-                   "max_ms", "min_ms", "ratio", "threshold", "slowest",
-                   "flagged"},
-    "collective.wait": {"ev", "ts", "trace", "span", "site", "wall_ms",
-                        "bytes", "procs"},
-    "slo.violation": {"ev", "ts", "trace", "span", "slo", "metric",
-                      "value", "target", "burn_rate"},
-    # the StreamGraft lifecycle (round 11): windowed drift scoring, the
-    # sustained-drift firing, the retrain completion, and the serving
-    # plane's hot swap — docs/observability.md event table
-    "drift.window": {"ev", "ts", "trace", "span", "window", "divergence",
-                     "threshold", "streak"},
-    "drift.detected": {"ev", "ts", "trace", "span", "window", "divergence",
-                       "threshold", "windows"},
-    "drift.retrain": {"ev", "ts", "trace", "span", "window", "model",
-                      "version", "rows", "dur_ms"},
-    "drift.retrain.failed": {"ev", "ts", "trace", "span", "window", "model",
-                             "error"},
-    "model.swap": {"ev", "ts", "trace", "span", "model", "version",
-                   "family", "warmed"},
-    # ShardGraft (round 12): the run's hardware identity — journaled at
-    # run start so every bench/journal artifact self-describes what it
-    # ran on (device kind, mesh shape, axis names; CrossGraft added the
-    # process count — a global mesh's axes carry the proc axis too)
-    "shard.topology": {"ev", "ts", "trace", "span", "devices",
-                       "device_kind", "mesh", "axes", "procs"},
-    # CrossGraft (this round): one coordinator-join record per worker —
-    # the hardened bounded join (parallel/mesh.py::journal_fleet_join);
-    # proc/host identity rides the GraftFleet stamp
-    "fleet.join": {"ev", "ts", "trace", "span", "coordinator", "nprocs",
-                   "attempts", "wall_ms"},
-    # GraftProf (round 14): the compiled-program registry (one event per
-    # distinct (site, compile key) with AOT cost fields — null when the
-    # backend degrades to shapes-only), the cumulative per-program wall
-    # totals, device-memory gauges, the bench sentinel's verdict, and the
-    # per-stage XProf capture path — docs/observability.md event table
-    "program.compiled": {"ev", "ts", "trace", "span", "key", "site",
-                         "flops", "bytes_accessed", "output_bytes",
-                         "temp_bytes", "source", "shapes"},
-    "program.profile": {"ev", "ts", "trace", "span", "key", "site",
-                        "dispatches", "wall_ms"},
-    "device.memory": {"ev", "ts", "trace", "span", "site", "device",
-                      "bytes_in_use", "peak_bytes"},
-    "bench.regression": {"ev", "ts", "trace", "span", "verdict", "compared",
-                         "regressed", "skipped", "missing", "baseline"},
-    "xla.trace": {"ev", "ts", "trace", "span", "stage", "dir"},
-    # ElasticGraft (round 16): a restore-time topology crossing — the
-    # suffix a snapshot was written under, the one it was redistributed
-    # onto, and how many accumulator entries moved
-    # (checkpoint/reshard.py::journal_reshard) — and the conf-driven
-    # fault family's injected-kill record (utils/retry.py::FaultPlan,
-    # journaled BEFORE the raise so a killed run's journal explains
-    # itself) — docs/observability.md event table
-    "checkpoint.reshard": {"ev", "ts", "trace", "span", "dir", "run",
-                           "src", "dst", "keys"},
-    "fault.injected": {"ev", "ts", "trace", "span", "site", "hit"},
-    # FleetServe (round 17): the replica pool's lifecycle — a replica
-    # leaving rotation (died / heartbeat / breaker / scale.down, with how
-    # many stranded requests were failed over), a replica entering it
-    # (start / probe / replace / scale-up), an autoscaler decision over
-    # the burn/queue gauges, and one request's failover hop — the events
-    # docs/runbooks/replica_loss_triage.md walks (serving/pool.py)
-    "pool.replica.down": {"ev", "ts", "trace", "span", "replica",
-                          "reason", "pending"},
-    "pool.replica.up": {"ev", "ts", "trace", "span", "replica", "reason"},
-    "pool.scale": {"ev", "ts", "trace", "span", "direction", "ready",
-                   "total", "burn", "queue_frac", "reason"},
-    "pool.failover": {"ev", "ts", "trace", "span", "rid", "model",
-                      "from", "to", "attempt"},
-    # GraftPool (round 18): the tenant-arbitration lifecycle — a tenant's
-    # contract admitted onto the pool (once per journal), the throttle
-    # latch firing per excursion (quota/priority/share/backlog pacing),
-    # and a tenant-scoped shed carrying the quota that fired plus the
-    # queue drain estimate the HTTP 429's Retry-After renders
-    # (tenancy/arbiter.py + serving/batcher.py's door shed — same shape)
-    "tenant.admitted": {"ev", "ts", "trace", "span", "tenant", "share",
-                        "priority", "max_inflight", "queue_depth"},
-    "tenant.throttled": {"ev", "ts", "trace", "span", "tenant", "reason",
-                         "waiting", "inflight"},
-    "tenant.shed": {"ev", "ts", "trace", "span", "tenant", "quota",
-                    "waiting", "inflight", "retry_after_ms"},
-    # PlanGraft (round 19): the planner's one record of what it decided
-    # before anything executed — unit/stage shape, which rewrites fired,
-    # and the summed AOT estimate (null when the backend degraded to
-    # shapes-only) — pipeline/plan.py::journal_plan
-    "plan.compiled": {"ev", "ts", "trace", "span", "units", "stages",
-                      "fused", "rewrites", "source", "est_flops",
-                      "est_bytes"},
-}
+# The schema itself lives in avenir_tpu/telemetry/schema.py (round 21):
+# ONE source of truth imported by this gate AND cross-checked by
+# graftlint's GL007 against every emit site in the tree.
+from avenir_tpu.telemetry.schema import (  # noqa: E402
+    GOLDEN_EVENT_KEYS,
+    STAMP_KEYS,
+    event_shapes,
+)
 
-# GraftFleet (round 15): EVERY journaled event additionally carries the
-# writer-identity stamp — process index + host (and `replica` when a
-# writer suffix is set) — so a merged fleet view attributes each event
-# without parsing shard filenames
-STAMP_KEYS = {"proc", "host"}
 
 
 class _FakeDevice:
@@ -379,6 +279,20 @@ def test_golden_event_shapes(tmp_path):
         monitor.prime([(1,)])
         monitor.observe([(2,)])
         tracer.event("checkpoint.save", dir="d", run="r", rows=10, chunk=2)
+        # dual-producer events (EVENT_SHAPE_VARIANTS): the stream
+        # checkpointer writes {dir, run, rows, chunk} while the RL
+        # supervisor checkpoints its restart ledger as {scope, events} —
+        # both shapes must stay pinned, so both are emitted here
+        tracer.event("checkpoint.save", scope="rl", events=7)
+        tracer.event("checkpoint.restore", dir="d", run="r", rows=10,
+                     chunk=2)
+        tracer.event("checkpoint.restore", scope="rl", events=7)
+        tracer.event("server.restart", scope="rl", restarts=1,
+                     error="OSError: boom")
+        tracer.event("stage.skipped", stage="serve", output="/tmp/scored")
+        tracer.event("serve.replay", model="naiveBayes", rows=8,
+                     max_inflight=4)
+        tracer.event("canary", ms=0.42, when="pre_run")
         tracer.event("drift.window", window=1, divergence=0.02,
                      threshold=0.1, streak=0)
         tracer.event("drift.detected", window=3, divergence=0.2,
@@ -389,6 +303,9 @@ def test_golden_event_shapes(tmp_path):
                      error="OSError: no space left on device")
         tracer.event("model.swap", model="naiveBayes", version=2,
                      family="naiveBayes", warmed=True)
+        # shape-pinning emit of a once-per-run event; the live producer
+        # (parallel/shard.py) goes through event_once
+        # graftlint: disable=GL011
         tracer.event("shard.topology", devices=8, device_kind="cpu",
                      mesh={"proc": 2, "data": 4}, axes=["proc", "data"],
                      procs=2)
@@ -481,10 +398,10 @@ def test_golden_event_shapes(tmp_path):
     tel.tracer().disable()
     seen = {}
     for event in read_events(path):
-        seen.setdefault(event["ev"], set(event))
+        seen.setdefault(event["ev"], set()).add(frozenset(event))
     assert set(seen) == set(GOLDEN_EVENT_KEYS)
-    for ev, keys in GOLDEN_EVENT_KEYS.items():
-        want = keys | STAMP_KEYS
+    for ev in GOLDEN_EVENT_KEYS:
+        want = {shape | STAMP_KEYS for shape in event_shapes(ev)}
         assert seen[ev] == want, f"{ev} schema drifted: {seen[ev]} != {want}"
     # root span.open: parent is present and null (roots are identifiable)
     root_open = next(e for e in read_events(path) if e["ev"] == "span.open")
