@@ -12,6 +12,7 @@ to wildcards ("Serving.*"), matching docs written as
 
 COUNTER_GROUPS = {
     'Aggregate': 'docs/observability.md',
+    'Fleet': 'docs/architecture.md',
     'Groups': 'docs/observability.md',
     'Iterations': 'docs/observability.md',
     'Model': 'docs/observability.md',
@@ -39,7 +40,7 @@ SPAN_SITES = {
     'feeder.stage': 'docs/observability.md',
     'job.*': 'docs/observability.md',
     'pipeline.run': 'docs/observability.md',
-    'probe': 'docs/observability.md',
+    'probe': 'docs/jobs.md',
     'scan': 'docs/observability.md',
     'scan.chunk': 'docs/observability.md',
     'scan.fused': 'docs/observability.md',

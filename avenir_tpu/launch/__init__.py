@@ -154,15 +154,23 @@ class FleetResult:
         return next(w.output for w in self.workers if w.rank == rank)
 
 
-def merge_fleet_journal(journal_dir: str) -> Optional[str]:
-    """Merge the newest run's per-process journal shards under
-    ``journal_dir`` into one time-ordered ``fleet-<run>.jsonl`` view
+def merge_fleet_journal(journal_dir: str,
+                        run_id: Optional[str] = None) -> Optional[str]:
+    """Merge one run's per-process journal shards under ``journal_dir``
+    into one time-ordered ``fleet-<run>.jsonl`` view
     (``telemetry/journal.py::merge_journals`` — torn tails and missing
-    crashed-worker shards tolerated).  Returns the merged path, or None
-    when the directory holds no shards (tracing was off)."""
+    crashed-worker shards tolerated).  Sweeps EVERY writer suffix of the
+    run — scan workers' ``w<k>``, serving replicas, tenant planes and the
+    GlobalServe router alike (the shard pattern is
+    ``run-<id>.proc-<k>[-<suffix>].jsonl``; nothing here assumes ``w<k>``)
+    — so one file holds the whole fleet.  ``run_id`` pins WHICH run when
+    the caller knows it (GlobalServe teardown, where a long-lived journal
+    dir may hold earlier runs); default is the newest run in the
+    directory.  Returns the merged path, or None when the directory holds
+    no shards (tracing was off)."""
     from avenir_tpu.telemetry.journal import merge_journals
 
-    run_id, shards, events = merge_journals(journal_dir)
+    run_id, shards, events = merge_journals(journal_dir, run_id=run_id)
     if run_id is None:
         return None
     out_path = os.path.join(journal_dir, f"fleet-{run_id}.jsonl")

@@ -1,6 +1,6 @@
 """Fleet launcher CLI — ``python -m avenir_tpu.launch``.
 
-Two modes, one command line (docs/jobs.md "Fleet launcher"):
+Three modes, one command line (docs/jobs.md "Fleet launcher"):
 
 - **spawn** (``--nprocs N``): bring up N local worker processes as one
   jax-distributed fleet over a local coordinator, run the worker argv in
@@ -8,7 +8,15 @@ Two modes, one command line (docs/jobs.md "Fleet launcher"):
 - **join** (no ``--nprocs``, ``AVENIR_PROCESS_ID`` set): the process was
   provisioned externally (cluster scheduler started every rank) — exec
   the worker argv in place; the worker joins through the same hardened
-  coordinator join via its environment.
+  coordinator join via its environment;
+- **serve** (``--serve --conf serve.properties --nprocs N``): GlobalServe
+  (round 20) — bring up N full serving planes (one
+  ``python -m avenir_tpu.serving`` process each, a ReplicaPool inside
+  when ``pool.*`` is armed) and front them with the tenant-aware
+  :class:`~avenir_tpu.serving.global_pool.GlobalRouter` on
+  ``fleet.http.port``; on teardown every shard — workers, tenants and the
+  router — merges into one ``fleet-<run>.jsonl``
+  (docs/deployment.md "Cross-host serving").
 
 Examples::
 
@@ -19,6 +27,9 @@ Examples::
     # a benchmark script across 2 workers, journals merged
     python -m avenir_tpu.launch --nprocs 2 --journal-dir /tmp/tel -- \\
         benchmarks/multichip_scan.py --nprocs 2
+
+    # a 2-process serving fleet behind one logical frontend
+    python -m avenir_tpu.launch --serve --conf serve.properties --nprocs 2
 """
 
 from __future__ import annotations
@@ -62,7 +73,33 @@ def main(argv: List[str]) -> int:
     ap.add_argument("--journal-dir", default=None,
                     help="trace.journal.dir of the workers; shards are "
                          "merged into fleet-<run>.jsonl on teardown")
+    ap.add_argument("--serve", action="store_true",
+                    help="GlobalServe mode: front --nprocs serving worker "
+                         "processes (built from --conf) with one "
+                         "GlobalRouter on fleet.http.port")
+    ap.add_argument("--conf", default=None,
+                    help="(--serve) serving properties file, shared by "
+                         "every worker process")
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="(--serve) override fleet.http.port for the "
+                         "router frontend")
     args = ap.parse_args(opts)
+
+    if args.serve:
+        if not args.conf:
+            ap.error("--serve requires --conf <serve.properties>")
+        if args.nprocs < 1:
+            ap.error("--serve requires --nprocs >= 1")
+        # lazy import: the launcher module itself stays stdlib-only at
+        # import (the join-mode exec path must not pay a jax import)
+        from avenir_tpu.serving.global_pool import serve_fleet
+
+        try:
+            return serve_fleet(args.conf, args.nprocs,
+                               http_port=args.http_port)
+        except LaunchError as e:
+            print(f"launch error: {e}", file=sys.stderr)
+            return 3
 
     try:
         if not args.nprocs:

@@ -37,6 +37,11 @@ def main(argv: List[str]) -> int:
                     help="properties file (serve.* keys + model artifacts)")
     ap.add_argument("--http-port", type=int, default=None,
                     help="override serve.http.port")
+    ap.add_argument("-D", dest="overrides", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="conf override (repeatable) — how the GlobalServe "
+                         "launcher pins per-worker keys (trace.run.id, "
+                         "split tenant contracts) over a shared conf file")
     args = ap.parse_args(argv)
 
     from avenir_tpu.serving.batcher import BucketedMicrobatcher
@@ -48,6 +53,11 @@ def main(argv: List[str]) -> int:
     from avenir_tpu.serving.registry import ModelRegistry
 
     conf = JobConfig.from_file(args.conf)
+    for item in args.overrides:
+        key, eq, value = item.partition("=")
+        if not eq or not key.strip():
+            ap.error(f"-D expects KEY=VALUE, got {item!r}")
+        conf.set(key.strip(), value.strip())
     # wire GraftTrace/GraftProf from the same properties file the models
     # load from (trace.on / profile.on — both default off); a replica
     # pool sets trace.writer.suffix per worker, which names this
@@ -85,11 +95,19 @@ def main(argv: List[str]) -> int:
         pool_note = ""
     port = (args.http_port if args.http_port is not None
             else conf.get_int("serve.http.port", 8390))
+    # GlobalServe (round 20): behind a fleet launcher the writer suffix
+    # names this worker PROCESS (w<k> via AVENIR_WRITER_SUFFIX), so the
+    # same suffix rides /metrics as the `worker` label — every scrape
+    # surface in a fleet is distinguishable even with identical replica
+    # sets (the router scrapes as worker="router")
+    suffix = (conf.get("trace.writer.suffix")
+              or tel.tracer().writer_suffix or None)
     http = ScoreHTTPServer(
         batcher, port=port, slo=slo,
         identity=fleet_identity(
-            replica=conf.get("trace.writer.suffix"),
-            tenant=conf.get("tenant.id"))).start()
+            replica=suffix,
+            tenant=conf.get("tenant.id"),
+            worker=suffix)).start()
     print(f"serving {names} on "
           f"http://{http.address[0]}:{http.address[1]} "
           f"(buckets {batcher.buckets}){pool_note}"

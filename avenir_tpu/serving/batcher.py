@@ -77,13 +77,19 @@ class PendingRequest:
     into the ``serve.request`` span, so a pool's failover dedupe — "this
     request scored exactly once, on exactly one replica" — is assertable
     from the journal.  ``probe`` marks a breaker half-open liveness probe:
-    the dispatcher answers it without scoring (and without counters)."""
+    the dispatcher answers it without scoring (and without counters).
+
+    ``tenant`` (GlobalServe): captured from the SUBMITTER's ambient
+    labels, because the ``serve.request`` span is emitted by the
+    dispatcher thread, whose own contextvars never saw the tenant — the
+    attribute is what lets ``telemetry slo --label tenant=<id>`` gate one
+    tenant's requests out of a merged fleet journal."""
 
     __slots__ = ("model", "line", "enqueued", "result", "error", "_done",
-                 "trace_ctx", "rid", "probe")
+                 "trace_ctx", "rid", "probe", "tenant")
 
     def __init__(self, model: str, line: str, rid: Optional[str] = None,
-                 probe: bool = False):
+                 probe: bool = False, tenant: Optional[str] = None):
         self.model = model
         self.line = line
         self.enqueued = time.monotonic()
@@ -93,6 +99,8 @@ class PendingRequest:
         self.trace_ctx = tel.tracer().current()
         self.rid = rid
         self.probe = probe
+        self.tenant = tenant if tenant is not None \
+            else tel.current_label("tenant")
 
     def finish(self, result: Optional[str] = None,
                error: Optional[ServingError] = None) -> None:
@@ -571,6 +579,8 @@ class BucketedMicrobatcher:
                     attrs["replica"] = self.name
                 if req.rid is not None:
                     attrs["rid"] = req.rid
+                if req.tenant:
+                    attrs["tenant"] = req.tenant
                 if pid is not None:
                     attrs["program"] = pid
                 tracer.emit_span("serve.request", wait_s,

@@ -72,3 +72,21 @@ class ReplicaDownError(ServingError):
     without risking a double score (``serving/pool.py`` failover)."""
 
     code = "REPLICA_DOWN"
+
+
+class WorkerDownError(ReplicaDownError):
+    """GlobalServe (``serving/global_pool.py``): the worker PROCESS
+    holding this request died or stopped answering before a response
+    landed — a refused/reset connection, or a worker-side 503 whose body
+    carries the retryable ``REPLICA_DOWN`` code.  Subclasses
+    :class:`ReplicaDownError` so the transport status (503) and the
+    retryability contract are inherited: the router only raises this when
+    no response arrived (or the worker itself vouched the request never
+    scored), so a failover re-send cannot double-score.  ``worker`` names
+    the process for client-side triage."""
+
+    code = "WORKER_DOWN"
+
+    def __init__(self, message: str, worker: str = ""):
+        super().__init__(message)
+        self.worker = worker

@@ -205,6 +205,9 @@ class ScoreHTTPServer:
                                      "message": self.path})
 
             def do_POST(self):
+                if self.path == "/swap":
+                    self._do_swap()
+                    return
                 if self.path != "/score":
                     self._send(404, {"error": "NOT_FOUND",
                                      "message": self.path})
@@ -216,6 +219,18 @@ class ScoreHTTPServer:
                     rows = req["rows"]
                     if isinstance(rows, str):
                         rows = [rows]
+                    # GlobalServe extras: a router upstream threads its
+                    # attempt-qualified rids (journal accounting across
+                    # the hop) and the submitter's tenant label (the
+                    # worker's DRR arbitration + span attribution)
+                    rids = req.get("rids")
+                    tenant = req.get("tenant")
+                    if rids is not None and (
+                            not isinstance(rids, list)
+                            or len(rids) != len(rows)):
+                        raise ValueError(
+                            f"rids must be a list of len(rows)="
+                            f"{len(rows)} request ids")
                 except (ValueError, KeyError, TypeError) as exc:
                     self._send(400, {
                         "error": "BAD_REQUEST",
@@ -223,26 +238,106 @@ class ScoreHTTPServer:
                                    f'{{"model": ..., "rows": [...]}}: {exc}'})
                     return
                 try:
-                    results = outer.score_rows(model, rows)
+                    results = outer.score_rows(model, rows, rids=rids,
+                                               tenant=tenant)
                 except ServingError as err:
                     self._send(_status_for(err), _error_body(err),
                                headers=_retry_after_header(err))
                     return
                 self._send(200, {"model": model, "results": results})
 
+            def _do_swap(self):
+                # GlobalServe rolling fleet swap lands here one worker at
+                # a time: build the incoming entry from the posted props
+                # and run the batcher/pool swap barrier
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    model = req["model"]
+                    props = req.get("props") or {}
+                    warm = bool(req.get("warm", True))
+                    if not isinstance(props, dict):
+                        raise ValueError("props must be an object")
+                except (ValueError, KeyError, TypeError) as exc:
+                    self._send(400, {
+                        "error": "BAD_REQUEST",
+                        "message": f"body must be JSON "
+                                   f'{{"model": ..., "props": {{...}}}}: '
+                                   f"{exc}"})
+                    return
+                try:
+                    doc = outer.swap_model(model, props, warm=warm)
+                except ServingError as err:
+                    self._send(_status_for(err), _error_body(err),
+                               headers=_retry_after_header(err))
+                    return
+                self._send(200, doc)
+
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 
-    def score_rows(self, model: str, rows: List[str]) -> List[str]:
+    def score_rows(self, model: str, rows: List[str],
+                   rids: Optional[List[str]] = None,
+                   tenant: Optional[str] = None) -> List[str]:
         """Submit all rows (they microbatch together), wait for all.  The
         first typed error aborts the call; rows already queued behind it
         still score and are discarded — shed/timeout accounting stays
-        truthful either way."""
-        pending: List[PendingRequest] = [
-            self.batcher.submit_nowait(model, row) for row in rows]
+        truthful either way.  ``rids`` (GlobalServe) pins each row's
+        request id (else the plane assigns its own); ``tenant`` scopes the
+        submits under that ambient tenant label so worker-local DRR
+        arbitration and span attribution see the ORIGINAL submitter's
+        tenant, not the router process."""
+        import contextlib
+
+        from avenir_tpu.telemetry import spans as _tel
+
+        if rids is not None and len(rids) != len(rows):
+            raise RequestError(
+                f"rids must pair 1:1 with rows ({len(rids)} != {len(rows)})")
+        scope = (_tel.label_scope(tenant=tenant) if tenant
+                 else contextlib.nullcontext())
+        with scope:
+            pending: List[PendingRequest] = [
+                self.batcher.submit_nowait(
+                    model, row, rid=rids[i] if rids else None)
+                for i, row in enumerate(rows)]
         return [p.wait(self.batcher.request_timeout_s + 30.0)
                 for p in pending]
+
+    def swap_model(self, model: str, props: dict,
+                   warm: bool = True) -> dict:
+        """``POST /swap`` body: build the incoming entry from ``props``
+        (the posted keys are a self-contained job conf for the model's
+        family loader) and hand it to the serving plane's swap barrier —
+        a plain batcher warms-then-publishes, a ReplicaPool rolls replica
+        by replica.  Returns the new version (for a pool: the SLOWEST
+        replica's, i.e. the rollout is done when ``version`` moved)."""
+        from avenir_tpu.core.config import ConfigError, JobConfig
+        from avenir_tpu.serving.registry import FAMILIES
+
+        roll = getattr(self.batcher, "swap_fleet", None)
+        if callable(roll):
+            # a GlobalRouter upstream: /swap IS the rolling fleet swap —
+            # the router re-posts these props to each worker's /swap one
+            # at a time, holding the ready floor between hops
+            return roll(model, dict(props), warm=warm)
+        loader = FAMILIES.get(model)
+        if loader is None:
+            raise UnknownModelError(
+                f"unknown serving family {model!r} "
+                f"(known: {sorted(FAMILIES)})")
+        try:
+            entry = loader.from_conf(JobConfig(dict(props)))
+        except ConfigError as exc:
+            raise RequestError(
+                f"swap props for {model!r} rejected: {exc}") from exc
+        result = self.batcher.swap(model, entry, warm=warm)
+        if isinstance(result, dict):
+            version = min(result.values()) if result else None
+            return {"model": model, "version": version,
+                    "versions": result}
+        return {"model": model, "version": result}
 
     @property
     def address(self) -> Tuple[str, int]:

@@ -344,8 +344,13 @@ class ReplicaPool:
             req.tried.add(replica.name)
             return
 
-    def submit_nowait(self, model: str, line: str) -> PoolRequest:
-        req = PoolRequest(self, model, line, rid=f"q{next(self._rid)}")
+    def submit_nowait(self, model: str, line: str,
+                      rid: Optional[str] = None) -> PoolRequest:
+        # caller-assigned rid (GlobalServe: the router's attempt-qualified
+        # ``g<n>.a<k>``) wins over the pool's own ``q<n>`` — the one id
+        # that threads the request through the merged fleet journal
+        req = PoolRequest(self, model, line,
+                          rid=rid or f"q{next(self._rid)}")
         self.counters.increment("Pool", "submitted")
         self._submit_on(req)
         return req

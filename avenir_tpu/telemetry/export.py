@@ -42,14 +42,20 @@ def _label_text(labels: Optional[Mapping[str, str]]) -> str:
 
 
 def fleet_identity(replica: Optional[str] = None,
-                   tenant: Optional[str] = None) -> Dict[str, str]:
+                   tenant: Optional[str] = None,
+                   worker: Optional[str] = None) -> Dict[str, str]:
     """This writer's scrape identity: the jax process index (0 outside a
     distributed run — guarded, never initializes a backend by surprise)
     plus the replica/worker suffix when the deployment sets one
     (``trace.writer.suffix`` — the same knob that names the journal
     shard, so scrape labels and shard names agree) and — GraftPool,
     round 18 — the tenant a dedicated serving plane belongs to
-    (``tenant.id``), so per-tenant scrapes never collide on series."""
+    (``tenant.id``), so per-tenant scrapes never collide on series.
+
+    ``worker`` (GlobalServe, this round) names the serving PROCESS in a
+    launched fleet — ``w<k>`` on workers, ``router`` on the global
+    frontend — so every ``/metrics`` scrape in the fleet is
+    distinguishable even when two workers run identical replica sets."""
     proc = 0
     try:
         import jax
@@ -62,6 +68,8 @@ def fleet_identity(replica: Optional[str] = None,
         out["replica"] = str(replica)
     if tenant:
         out["tenant"] = str(tenant)
+    if worker:
+        out["worker"] = str(worker)
     return out
 
 

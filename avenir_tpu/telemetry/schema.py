@@ -128,6 +128,23 @@ GOLDEN_EVENT_KEYS: Dict[str, Set[str]] = {
                    "total", "burn", "queue_frac", "reason"},
     "pool.failover": {"ev", "ts", "trace", "span", "rid", "model",
                       "from", "to", "attempt"},
+    # GlobalServe (round 20): the FleetServe lifecycle one level up —
+    # worker PROCESSES joining/leaving the serving fleet (died/breaker/
+    # retire vs spawn/probe), the burn-rate autoscaler at process
+    # granularity, per-request failover hops ACROSS processes (`rid` is
+    # the router's attempt-qualified id — the zero-lost/zero-double key
+    # of the merged-journal accounting), and the rolling fleet-wide swap
+    # with the ready-capacity floor it held (serving/global_pool.py).
+    "fleet.pool.worker.down": {"ev", "ts", "trace", "span", "worker",
+                               "reason", "pending"},
+    "fleet.pool.worker.up": {"ev", "ts", "trace", "span", "worker",
+                             "reason"},
+    "fleet.pool.scale": {"ev", "ts", "trace", "span", "direction", "ready",
+                         "total", "burn", "queue_frac", "reason"},
+    "fleet.pool.failover": {"ev", "ts", "trace", "span", "rid", "model",
+                            "from", "to", "attempt"},
+    "fleet.pool.swap": {"ev", "ts", "trace", "span", "worker", "model",
+                        "version", "ready", "floor"},
     # GraftPool (round 18): the tenant-arbitration lifecycle — a tenant's
     # contract admitted onto the pool (once per journal), the throttle
     # latch firing per excursion (quota/priority/share/backlog pacing),

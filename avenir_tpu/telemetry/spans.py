@@ -472,7 +472,14 @@ def configure(conf) -> Tracer:
     # a merged fleet view attributes each shard's events without parsing
     # filenames.  In-process multi-tenant runs use label_scope instead.
     tenant = conf.get("tenant.id", "") or ""
-    suffix = conf.get("trace.writer.suffix", "") or tenant
+    # GlobalServe (this round): a launcher-spawned serving worker gets its
+    # shard suffix via AVENIR_WRITER_SUFFIX (the launch env contract) when
+    # the conf file — shared by the whole fleet — can't name one per
+    # process; an explicit conf key still wins, then the env, then the
+    # tenant id.
+    suffix = (conf.get("trace.writer.suffix", "")
+              or os.environ.get("AVENIR_WRITER_SUFFIX", "")
+              or tenant)
     fleet = nprocs > 1 or bool(suffix) or bool(conf.get("trace.run.id"))
     max_mb = conf.get_float("telemetry.journal.max.mb", 64.0)
     t.enable(conf.get("trace.journal.dir") or ".",

@@ -126,6 +126,44 @@ def contracts_from_conf(conf) -> Dict[str, TenantContract]:
     return out
 
 
+def split_contracts(conf, nworkers: int) -> Dict[str, str]:
+    """GlobalServe (round 20): one worker's 1/N slice of the conf's
+    tenant contracts, as ``-D``-able conf overrides.
+
+    The fleet launcher hands EVERY worker the same properties file; these
+    overrides re-scope the absolute quotas so that N workers' local DRR
+    arbitration sums back to the declared GLOBAL contract:
+
+    - ``max.inflight`` and ``queue.depth`` are absolute counts →
+      ceil-divided across workers (ceil, so N workers' slices always
+      cover the global quota — the router's OWN door enforces the exact
+      fleet-wide ceiling with the unsplit contracts, so a worker-side
+      over-grant of < 1 slot per worker never admits past the global
+      limit);
+    - ``share`` and ``priority`` are RELATIVE weights/tiers — identical
+      on every worker, a 3:1 split arbitrates 3:1 locally and therefore
+      3:1 globally — so they are not overridden;
+    - ``queue.timeout.ms`` and ``slo.*`` are per-request/per-journal
+      semantics, unsplit.
+
+    Raises the same ConfigError a malformed contract raises anywhere
+    (the split must not silently launder a typo into a running fleet)."""
+    from avenir_tpu.core.config import ConfigError
+
+    if nworkers < 1:
+        raise ConfigError(
+            f"split_contracts needs nworkers >= 1, got {nworkers}")
+    out: Dict[str, str] = {}
+    for name, contract in contracts_from_conf(conf).items():
+        if contract.max_inflight:
+            out[f"tenant.{name}.max.inflight"] = str(
+                -(-contract.max_inflight // nworkers))
+        if contract.queue_depth:
+            out[f"tenant.{name}.queue.depth"] = str(
+                max(-(-contract.queue_depth // nworkers), 1))
+    return out
+
+
 def tenant_slo_rules(conf, tenant: str) -> List:
     """The tenant's own SLO rule set: every ``tenant.<id>.slo.<name>.*``
     key re-read through the round-15 grammar (``slo.* `` semantics —

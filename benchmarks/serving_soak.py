@@ -5,6 +5,18 @@ path — a fault-injected replica KILL and a rolling hot-swap both land
 mid-soak, and acceptance is a ``telemetry slo`` exit 0 plus journal-proved
 request accounting.
 
+GlobalServe (round 20) adds ``--nprocs N``: the same drill at PROCESS
+granularity — bursty two-tenant traffic (alpha:beta 3:1 by contract)
+against a :class:`~avenir_tpu.serving.global_pool.GlobalRouter` fronting
+N REAL OS worker processes, one of which is **SIGKILLed** mid-soak.  The
+process autoscaler replaces it (``fleet.pool.autoscale.min``), a rolling
+fleet-wide hot-swap then rolls the retrained artifact across every worker
+without ready capacity dropping below the floor, and acceptance is read
+from the MERGED fleet journal (every worker shard + the router's own):
+zero-lost/zero-double request accounting over attempt-qualified rids
+(``g<n>.a<k>``), the ``fleet.pool.*`` lifecycle events present, and every
+surviving tenant's ``telemetry slo --label tenant=<id>`` gate exit 0.
+
 The traffic shape models the north-star claim in miniature: bursty
 arrivals (a repeating burst-size pattern, not a constant rate), mixed
 model families sharing one pool (naiveBayes + logistic over the churn
@@ -272,8 +284,290 @@ def run_soak(bursts=48, replicas=2, p99_target_ms=2000.0,
     return artifact
 
 
-def main():
-    print(json.dumps(run_soak()))
+def run_soak_fleet(nprocs=2, bursts=24, p99_target_ms=20000.0,
+                   shed_target=0.25, scale=0.5, canary=True):
+    """The GlobalServe drill: ``nprocs`` real serving processes behind
+    one :class:`GlobalRouter`, two tenants under contract, one worker
+    SIGKILLed mid-soak, a rolling fleet swap after the replacement lands.
+    Returns the artifact dict; raises RuntimeError on any gate failure."""
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.serving.errors import ServingError
+    from avenir_tpu.serving.global_pool import GlobalRouter, WorkerSpawner
+    from avenir_tpu.telemetry import spans as tel
+    from avenir_tpu.telemetry.__main__ import main as telemetry_cli
+    from avenir_tpu.telemetry.journal import read_events
+    from avenir_tpu.tenancy.contract import split_contracts
+    from avenir_tpu.utils.rig_canary import matmul_canary_ms
+
+    if nprocs < 2:
+        raise RuntimeError("the fleet drill needs --nprocs >= 2 (one "
+                           "worker dies; survivors must carry the soak)")
+    root = tempfile.mkdtemp(prefix="globalserve_soak_")
+    churn, lines = _train_workspace(root)
+    pattern = [max(int(b * scale), 2) for b in BURST_PATTERN]
+    total_requests = sum(pattern[b % len(pattern)] for b in range(bursts))
+    j = lambda *p: os.path.join(root, *p)
+    run_id = "globalsoak"
+    props = {
+        **churn,
+        "bayesian.model.file.path": j("nb_model"),
+        "coeff.file.path": j("coeff.txt"),
+        "serve.models": "naiveBayes,logistic",
+        "serve.bucket.sizes": "1,2,4,8",
+        "serve.flush.deadline.ms": "4",
+        "serve.queue.depth": "256",
+        "serve.request.timeout.ms": "20000",
+        # each worker PROCESS runs a full (single-replica) ReplicaPool —
+        # the round-17 plane — while the process-granularity supervision
+        # lives in the router's fleet.pool.* family below
+        "pool.replicas": "1",
+        "pool.heartbeat.ms": "500",
+        "pool.monitor.interval.ms": "50",
+        "pool.failover.retries": "1",
+        # the global tenancy contracts (alpha:beta 3:1); the launcher
+        # hands each worker a 1/N split, the router enforces the full
+        # fleet-wide quota at its door
+        "tenant.alpha.share": "3",
+        "tenant.alpha.max.inflight": "64",
+        "tenant.beta.share": "1",
+        "tenant.beta.max.inflight": "32",
+        # the process-level supervision: fast heartbeats, two failover
+        # hops per request, the autoscaler replacing lost workers, and
+        # the rolling-swap ready floor
+        "fleet.pool.breaker.failures": "3",
+        "fleet.pool.heartbeat.ms": "500",
+        "fleet.pool.breaker.halfopen.ms": "1000",
+        "fleet.pool.failover.retries": "2",
+        "fleet.pool.monitor.interval.ms": "100",
+        "fleet.pool.client.threads": "8",
+        "fleet.pool.autoscale.on": "true",
+        "fleet.pool.autoscale.min": str(nprocs),
+        "fleet.pool.autoscale.max": str(nprocs + 1),
+        "fleet.pool.autoscale.interval.sec": "0.5",
+        "fleet.pool.swap.floor": "1",
+        # the observability plane the acceptance reads: every process
+        # shards the SAME run (workers via -D trace.run.id, suffix via
+        # AVENIR_WRITER_SUFFIX; the router under suffix "router")
+        "trace.on": "true",
+        "trace.journal.dir": root,
+        "trace.run.id": run_id,
+        # the per-tenant SLO gate closes on these over `--label tenant=`
+        "slo.p99.metric": "p99.latency.ms",
+        "slo.p99.target": str(p99_target_ms),
+    }
+    conf_path = j("fleet.properties")
+    with open(conf_path, "w") as fh:
+        fh.write("\n".join(f"{k}={v}" for k, v in props.items()) + "\n")
+    conf = JobConfig.from_file(conf_path)
+    # the router journals to its OWN shard of the shared run
+    router_conf = JobConfig(dict(conf.props), prefix=conf.prefix)
+    router_conf.set("trace.writer.suffix", "router")
+    tel.configure(router_conf)
+    canary_ms = matmul_canary_ms() if canary else None
+
+    spawner = WorkerSpawner(conf_path, run_id,
+                            overrides=split_contracts(conf, nprocs),
+                            echo=False)
+    workers = [spawner.spawn() for _ in range(nprocs)]
+    router = GlobalRouter.from_conf(conf, workers=workers,
+                                    spawner=spawner.spawn)
+
+    tenants = ("alpha", "alpha", "alpha", "beta")   # the 3:1 mix
+    outcomes = {}
+    door_shed = 0
+    kill_at = bursts // 3
+    swap_at = (2 * bursts) // 3
+    killed = workers[0].name
+    swap_result = None
+    burst_lat = []
+    t0 = time.perf_counter()
+    for b in range(bursts):
+        size = pattern[b % len(pattern)]
+        batch = []
+        tb = time.perf_counter()
+        for i in range(size):
+            model = ("naiveBayes", "logistic")[(b + i) % 2]
+            tenant = tenants[i % len(tenants)]
+            line = lines[(b * size + i) % len(lines)]
+            try:
+                with tel.label_scope(tenant=tenant):
+                    batch.append((tenant, router.submit_nowait(model, line)))
+            except ServingError:
+                door_shed += 1            # typed refusal at the fleet door
+        for tenant, req in batch:
+            try:
+                req.wait(60.0)
+                outcomes[req.rid] = ("ok", req.worker, tenant)
+            except ServingError as err:
+                outcomes[req.rid] = (err.code, req.worker, tenant)
+        burst_lat.append(time.perf_counter() - tb)
+        if b == kill_at:
+            # the chaos: a REAL OS SIGKILL on a worker process mid-soak —
+            # no drain, no handler; its in-flight requests must fail over
+            workers[0].proc.kill()
+        if b == swap_at:
+            # wait out the replacement first (the autoscaler's
+            # replace-below-min path), then roll the retrained artifact
+            # across the fleet without dropping below the ready floor
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and \
+                    router.stats()["fleet"]["ready"] < nprocs:
+                time.sleep(0.1)
+            swap_result = router.swap_fleet(
+                "naiveBayes",
+                {**churn, "bayesian.model.file.path": j("nb_model_v2")})
+    soak_s = time.perf_counter() - t0
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and \
+            router.stats()["fleet"]["ready"] < nprocs:
+        time.sleep(0.1)
+    fleet_stats = router.stats()["fleet"]
+    health = router.health()
+    tel.tracer().counters("fleet", router.counters)
+    router.close()                 # SIGTERMs survivors (drain + snapshot)
+    tel.tracer().disable()
+
+    # -- the merged fleet journal is the acceptance artifact ------------------
+    rc_merge = telemetry_cli(["merge", root, "--run", run_id])
+    fleet_path = j(f"fleet-{run_id}.jsonl")
+    if rc_merge != 0 or not os.path.exists(fleet_path):
+        raise RuntimeError(f"journal merge failed (rc={rc_merge})")
+    events = read_events(fleet_path)
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e["ev"], []).append(e)
+    for required in ("fleet.pool.worker.down", "fleet.pool.worker.up",
+                     "fleet.pool.scale", "fleet.pool.swap"):
+        if required not in by_ev:
+            raise RuntimeError(
+                f"fleet journal carries no {required!r} event — the drill "
+                f"did not exercise the process failure path")
+    if not any(e.get("reason") == "died"
+               for e in by_ev["fleet.pool.worker.down"]):
+        raise RuntimeError("no fleet.pool.worker.down reason=died event — "
+                           "the SIGKILL was never detected")
+
+    # -- zero lost, zero double: attempt-qualified rids across shards ---------
+    # every scored span carries its router rid g<n>.a<k> (attempt k) and
+    # its shard's worker stamp; the killed worker may hold ORPHANS — a
+    # request it scored+journaled but whose response died with it — and
+    # each such orphan's base rid must have been re-scored on a survivor
+    scored = {}                       # attempt rid -> [worker stamps]
+    for e in by_ev.get("span.close", []):
+        if e.get("name") != "serve.request":
+            continue
+        rid = (e.get("attrs") or {}).get("rid")
+        if rid and rid.startswith("g"):
+            scored.setdefault(rid, []).append(e.get("replica", "?"))
+    doubles = {rid: st for rid, st in scored.items() if len(st) > 1}
+    if doubles:
+        raise RuntimeError(f"attempt scored twice: {doubles}")
+    by_base = {}
+    for rid, stamps in scored.items():
+        base = rid.rsplit(".a", 1)[0]
+        by_base.setdefault(base, []).extend(stamps)
+    orphans = 0
+    for base, stamps in by_base.items():
+        if len(stamps) > 1:
+            survivors = [s for s in stamps if s != killed]
+            if len(survivors) > 1:
+                raise RuntimeError(
+                    f"request {base} scored on two SURVIVING workers "
+                    f"{stamps} — a true double score")
+            orphans += len(stamps) - 1
+    ok_rids = {rid for rid, (code, _, _) in outcomes.items()
+               if code == "ok"}
+    torn_tail_ok = 0
+    for rid in ok_rids:
+        if rid not in by_base:
+            # the one legal gap: the KILLED worker delivered the response
+            # but its journal tail was torn by the SIGKILL
+            if outcomes[rid][1] != killed:
+                raise RuntimeError(
+                    f"client success {rid} (worker {outcomes[rid][1]}) "
+                    f"has no scored span in the merged journal — a lost "
+                    f"request")
+            torn_tail_ok += 1
+    untyped = [rid for rid, (code, _, _) in outcomes.items()
+               if code not in ("ok", "SHED", "TENANT_SHED", "TIMEOUT",
+                               "WORKER_DOWN", "REPLICA_DOWN")]
+    if untyped:
+        raise RuntimeError(f"requests with untyped outcomes: {untyped[:5]}")
+
+    # -- every surviving tenant's SLO gate must exit 0 ------------------------
+    slo_exits = {}
+    for tenant in ("alpha", "beta"):
+        slo_exits[tenant] = telemetry_cli(
+            ["slo", fleet_path, "--conf", conf_path,
+             "--label", f"tenant={tenant}"])
+    if swap_result is None or swap_result["min_ready"] < \
+            swap_result["floor"]:
+        raise RuntimeError(
+            f"rolling fleet swap broke the ready floor: {swap_result}")
+    if any(v is None or v < 2 for v in swap_result["versions"].values()):
+        raise RuntimeError(
+            f"fleet swap never advanced every worker: {swap_result}")
+    shed = sum(1 for code, _, _ in outcomes.values()
+               if code in ("SHED", "TENANT_SHED"))
+    artifact = {
+        "benchmark": "serving_soak_fleet",
+        "canary_ms": round(canary_ms, 3) if canary_ms is not None else None,
+        "nprocs": nprocs,
+        "requests": total_requests,
+        "bursts": bursts,
+        "ok": len(ok_rids),
+        "shed": shed + door_shed,
+        "door_shed": door_shed,
+        "killed_worker": killed,
+        "orphan_scored_spans": orphans,
+        "torn_tail_ok": torn_tail_ok,
+        "failovers": fleet_stats.get("failovers", 0),
+        "workers_lost": fleet_stats.get("workers.lost", 0),
+        "workers_spawned": fleet_stats.get("workers.spawned", 0),
+        "workers_final": fleet_stats.get("workers", 0),
+        "events_per_sec": round(total_requests / soak_s, 1),
+        "burst_p99_ms": round(
+            sorted(burst_lat)[int(0.99 * (len(burst_lat) - 1))] * 1e3, 2),
+        "swap_min_ready": swap_result["min_ready"],
+        "swap_floor": swap_result["floor"],
+        "swap_versions": swap_result["versions"],
+        "fleet_events": {ev: len(by_ev.get(ev, []))
+                         for ev in ("fleet.pool.worker.down",
+                                    "fleet.pool.worker.up",
+                                    "fleet.pool.scale",
+                                    "fleet.pool.failover",
+                                    "fleet.pool.swap")},
+        "slo_exits": slo_exits,
+        "healthz_ready": bool(health["ready"]),
+    }
+    if any(rc != 0 for rc in slo_exits.values()):
+        raise RuntimeError(
+            f"a surviving tenant's SLO gate failed: {slo_exits}")
+    return artifact
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="FleetServe / GlobalServe chaos soak")
+    ap.add_argument("--nprocs", type=int, default=0,
+                    help="serving worker PROCESSES — 0 (default) runs the "
+                         "single-process ReplicaPool soak; >= 2 runs the "
+                         "GlobalServe drill with one worker SIGKILLed")
+    ap.add_argument("--bursts", type=int, default=None)
+    ap.add_argument("--no-canary", action="store_true")
+    args = ap.parse_args(argv)
+    if args.nprocs:
+        kwargs = {"nprocs": args.nprocs, "canary": not args.no_canary}
+        if args.bursts:
+            kwargs["bursts"] = args.bursts
+        print(json.dumps(run_soak_fleet(**kwargs)))
+    else:
+        kwargs = {"canary": not args.no_canary}
+        if args.bursts:
+            kwargs["bursts"] = args.bursts
+        print(json.dumps(run_soak(**kwargs)))
 
 
 if __name__ == "__main__":

@@ -769,6 +769,11 @@ def test_whole_tree_zero_nonbaselined_findings():
     # telemetry/schema.py sits inside the walked tree), counter/span
     # registry drift (GL008) — plus the new local rules GL009–GL012;
     # designed exceptions live in baseline.json, each with a why
+    # tests/test_globalserve.py + globalserve_worker.py likewise
+    # (round 20) — the GlobalServe gate drives the cross-process router
+    # (breaker, failover byte-identity, rolling fleet swap), where an
+    # undocumented fleet.pool.* key (GL004) or a fleet.pool.* event
+    # drifting from telemetry/schema.py (GL007) would hide
     findings = engine.run_paths(
         [str(REPO / "avenir_tpu"), str(REPO / "benchmarks"),
          str(REPO / "bench.py"), str(REPO / "tests" / "test_serving.py"),
@@ -786,7 +791,9 @@ def test_whole_tree_zero_nonbaselined_findings():
          str(REPO / "tests" / "test_tenancy.py"),
          str(REPO / "tests" / "crossgraft_worker.py"),
          str(REPO / "tests" / "test_multiprocess.py"),
-         str(REPO / "tests" / "test_plan.py")],
+         str(REPO / "tests" / "test_plan.py"),
+         str(REPO / "tests" / "test_globalserve.py"),
+         str(REPO / "tests" / "globalserve_worker.py")],
         root=str(REPO))
     live = [f for f in findings if not f.baselined]
     assert not live, (

@@ -96,6 +96,58 @@ def test_configure_writer_suffix_opts_into_federation(tmp_path):
         != rid
 
 
+def test_configure_adopts_env_writer_suffix(tmp_path, monkeypatch):
+    """GlobalServe (round 20): a launcher-spawned serving worker gets its
+    shard suffix via AVENIR_WRITER_SUFFIX — the conf file is SHARED by
+    the whole fleet, so it cannot name a per-process suffix — with an
+    explicit conf key still winning over the env."""
+    monkeypatch.setenv("AVENIR_WRITER_SUFFIX", "w7")
+    conf = JobConfig({"trace.on": "true",
+                      "trace.journal.dir": str(tmp_path / "a")})
+    tracer = tel.configure(conf)
+    assert ".proc-0-w7.jsonl" in tracer.journal_path
+    tracer.disable()
+    # explicit conf key wins over the env
+    conf2 = JobConfig({"trace.on": "true",
+                       "trace.journal.dir": str(tmp_path / "b"),
+                       "trace.writer.suffix": "router"})
+    tracer = tel.configure(conf2)
+    assert ".proc-0-router.jsonl" in tracer.journal_path
+    tracer.disable()
+
+
+def test_merge_fleet_journal_sweeps_all_suffixes_and_pins_run(tmp_path):
+    """GlobalServe satellite (round 20): the launcher's merge-on-teardown
+    sweeps EVERY writer suffix of a run — scan workers' ``w<k>``, serving
+    replicas, tenant planes and the router alike — into one
+    ``fleet-<id>.jsonl``, and ``run_id=`` pins WHICH run when the journal
+    dir holds several (the newest run is no longer assumed)."""
+    from avenir_tpu.launch import merge_fleet_journal
+
+    d = str(tmp_path)
+    # one serving-fleet run with non-scan writer suffixes...
+    for k, sfx in enumerate(("router", "w0", "tenant-alpha")):
+        jl = Journal(os.path.join(d, f"run-serve.proc-{k}-{sfx}.jsonl"),
+                     stamp={"proc": k, "host": "h", "replica": sfx})
+        jl.emit("canary", ms=1.0, when="pre_run")
+        jl.close()
+    # ...and a NEWER unrelated run that the pin must ignore
+    jl = Journal(os.path.join(d, "run-later.proc-0.jsonl"),
+                 stamp={"proc": 0, "host": "h"})
+    jl.emit("canary", ms=2.0, when="pre_run")
+    jl.close()
+    now = os.path.getmtime(os.path.join(d, "run-later.proc-0.jsonl"))
+    os.utime(os.path.join(d, "run-later.proc-0.jsonl"), (now + 60, now + 60))
+
+    merged = merge_fleet_journal(d, run_id="serve")
+    assert merged is not None and merged.endswith("fleet-serve.jsonl")
+    events = read_events(merged)
+    assert {e.get("replica") for e in events} == \
+        {"router", "w0", "tenant-alpha"}
+    # default (no run_id): newest run, unchanged round-15 behavior
+    assert merge_fleet_journal(d).endswith("fleet-later.jsonl")
+
+
 def test_merge_time_orders_attributes_and_tolerates_torn_tail(tmp_path,
                                                               capsys):
     d = str(tmp_path)

@@ -1,0 +1,492 @@
+"""GlobalServe (round 20) — the cross-host serving plane.
+
+The heart is cross-PROCESS failover correctness, pinned by a
+fresh-subprocess gate: two real serving worker processes (spawned through
+tests/globalserve_worker.py — the production bring-up path: env shard
+suffix, ``-D`` overrides, model load, HTTP), one conf-armed to die on its
+first dispatched batch, and the request the router re-sends to the
+survivor must score BYTE-IDENTICAL to the single-plane oracle.  Around
+it, in-process over real HTTP transports: health-gated least-load
+routing, the worker-level breaker (trip on consecutive transport
+failures, half-open healthz probe recovery), typed error mapping across
+the HTTP hop, the fleet-wide tenant quota at the router door, the
+rolling fleet swap holding the ready floor, process-granularity
+autoscale replacement, and the aggregate ``/healthz`` + ``worker``-
+labeled ``/metrics`` surfaces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.csv_io import write_csv
+from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+from avenir_tpu.jobs import get_job
+from avenir_tpu.jobs.base import read_lines
+from avenir_tpu.serving import (
+    BucketedMicrobatcher,
+    ModelRegistry,
+    ScoreHTTPServer,
+    ServableModel,
+    ShedError,
+)
+from avenir_tpu.serving.errors import (
+    TenantShedError,
+    UnknownModelError,
+    WorkerDownError,
+)
+from avenir_tpu.serving.global_pool import (
+    CLOSED,
+    OPEN,
+    GlobalRouter,
+    GlobalWorker,
+    WorkerClient,
+)
+from avenir_tpu.telemetry import spans as tel
+from avenir_tpu.telemetry.journal import read_events
+from avenir_tpu.tenancy.contract import TenantContract
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a real NB artifact (byte-identity + swap) + a fast fake family
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    root = tmp_path_factory.mktemp("globalserve")
+    j = lambda *p: str(root.joinpath(*p))
+    rows = generate_churn(400, seed=7)
+    write_csv(j("train.csv"), rows[:320])
+    write_csv(j("test.csv"), rows[320:])
+    write_csv(j("train2.csv"), generate_churn(300, seed=23))
+    root.joinpath("churn.json").write_text(json.dumps(CHURN_SCHEMA_JSON))
+    churn = {"feature.schema.file.path": j("churn.json")}
+    get_job("BayesianDistribution").run(JobConfig(dict(churn)),
+                                        j("train.csv"), j("nb_model"))
+    get_job("BayesianDistribution").run(JobConfig(dict(churn)),
+                                        j("train2.csv"), j("nb_model_v2"))
+    return {"j": j, "churn": churn}
+
+
+class EchoServable(ServableModel):
+    """Deterministic fake: instant scoring (``<line>,<tag>``), optional
+    per-call delay (holds a request in flight for quota tests)."""
+
+    family = "echo"
+
+    def __init__(self, tag="v1", delay_s=0.0):
+        super().__init__()
+        self.tag = tag
+        self.delay_s = delay_s
+
+    def score_lines(self, lines, pad_to):
+        self.compile_keys.add((pad_to,))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [f"{line},{self.tag}" for line in lines]
+
+    def warmup(self, pad_to):
+        self.compile_keys.add((pad_to,))
+
+
+def echo_worker(name, tag="v1", delay_s=0.0, props=None):
+    """One in-process 'worker': a real batcher behind a real HTTP server
+    (the actual cross-process transport), wrapped as a GlobalWorker."""
+    conf = JobConfig({"serve.bucket.sizes": "1,4",
+                      "serve.flush.deadline.ms": "5", **(props or {})})
+    registry = ModelRegistry().add("echo", EchoServable(tag, delay_s))
+    batcher = BucketedMicrobatcher.from_conf(registry, conf)
+    srv = ScoreHTTPServer(batcher).start()
+    host, port = srv.address
+    worker = GlobalWorker(name, WorkerClient(host, port, name=name))
+    return srv, batcher, worker
+
+
+def nb_worker(name, ws, extra=None):
+    """An in-process worker serving the REAL naiveBayes artifact."""
+    j, churn = ws["j"], ws["churn"]
+    conf = JobConfig({**churn,
+                      "bayesian.model.file.path": j("nb_model"),
+                      "serve.models": "naiveBayes",
+                      "serve.bucket.sizes": "1,4",
+                      "serve.flush.deadline.ms": "5", **(extra or {})})
+    registry = ModelRegistry.from_conf(conf)
+    batcher = BucketedMicrobatcher.from_conf(registry, conf)
+    srv = ScoreHTTPServer(batcher).start()
+    host, port = srv.address
+    worker = GlobalWorker(name, WorkerClient(host, port, name=name))
+    return srv, batcher, worker
+
+
+@pytest.fixture
+def traced(tmp_path):
+    tracer = tel.tracer().enable(str(tmp_path))
+    try:
+        yield tracer
+    finally:
+        tel.tracer().disable()
+
+
+# ---------------------------------------------------------------------------
+# routing, health gate, surfaces
+# ---------------------------------------------------------------------------
+
+def test_router_routes_scores_and_aggregates_health():
+    s0, b0, w0 = echo_worker("w0")
+    s1, b1, w1 = echo_worker("w1")
+    router = GlobalRouter([w0, w1], start_monitor=False)
+    try:
+        assert router.ready
+        assert router.submit("echo", "a,b") == "a,b,v1"
+        # the batcher-compatible surface serves the unchanged frontend
+        from avenir_tpu.telemetry.export import fleet_identity
+
+        with ScoreHTTPServer(
+                router,
+                identity=fleet_identity(worker="router")) as srv:
+            host, port = srv.address
+            base = f"http://{host}:{port}"
+            req = urllib.request.Request(
+                f"{base}/score",
+                data=json.dumps({"model": "echo",
+                                 "rows": ["x,y", "p,q"]}).encode(),
+                headers={"Content-Type": "application/json"})
+            doc = json.loads(urllib.request.urlopen(req).read())
+            assert doc["results"] == ["x,y,v1", "p,q,v1"]
+            # satellite: /healthz aggregates per-worker readiness rows
+            hz = json.loads(urllib.request.urlopen(f"{base}/healthz").read())
+            assert hz["ready"] is True
+            rows = {r["worker"]: r for r in hz["workers"]}
+            assert set(rows) == {"w0", "w1"}
+            assert all(r["ready"] and r["breaker"] == CLOSED
+                       for r in rows.values())
+            assert hz["queue"]["echo"]["cap"] == 2 * b0.queue_depth
+            # satellite: /metrics splices the worker label
+            metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert 'worker="router"' in metrics
+            # /stats carries the fleet roll-up
+            st = json.loads(urllib.request.urlopen(f"{base}/stats").read())
+            assert st["fleet"]["workers"] == 2 and st["fleet"]["ready"] == 2
+    finally:
+        router.close()
+        s0.stop(); b0.close(); s1.stop(); b1.close()
+
+
+def test_health_gate_excludes_unready_worker():
+    s0, b0, w0 = echo_worker("w0")
+    s1, b1, w1 = echo_worker("w1")
+    router = GlobalRouter([w0, w1], start_monitor=False)
+    try:
+        # w0 goes unready (its plane failed): the health gate must route
+        # every request to w1 — and the aggregate stays green (>= 1 ready)
+        b0.mark_failed()
+        router.monitor_once()
+        assert not w0.routable and w1.routable and router.ready
+        for i in range(4):
+            assert router.submit("echo", f"r{i},x") == f"r{i},x,v1"
+        health = router.health()
+        rows = {r["worker"]: r["ready"] for r in health["workers"]}
+        assert rows == {"w0": False, "w1": True} and health["ready"]
+    finally:
+        router.close()
+        s0.stop(); b0.close(); s1.stop(); b1.close()
+
+
+def test_least_load_prefers_shallower_worker():
+    s0, b0, w0 = echo_worker("w0")
+    s1, b1, w1 = echo_worker("w1")
+    router = GlobalRouter([w0, w1], start_monitor=False)
+    try:
+        with router._lock:
+            w0.inflight = 5                  # deeper by router bookkeeping
+        assert router._choose().name == "w1"
+        with router._lock:
+            w0.inflight = 0
+        assert router._choose(exclude={"w0"}).name == "w1"
+    finally:
+        router.close()
+        s0.stop(); b0.close(); s1.stop(); b1.close()
+
+
+# ---------------------------------------------------------------------------
+# typed errors across the HTTP hop; breaker lifecycle
+# ---------------------------------------------------------------------------
+
+def test_client_maps_worker_errors_to_typed_exceptions():
+    s0, b0, w0 = echo_worker("w0")
+    host, port = s0.address
+    client = WorkerClient(host, port, name="w0")
+    try:
+        with pytest.raises(UnknownModelError):
+            client.score("nosuch", ["a,b"])
+        assert client.healthz()["ready"] is True
+    finally:
+        s0.stop(); b0.close()
+    # the server is gone: transport failure -> retryable WorkerDownError
+    with pytest.raises(WorkerDownError) as ei:
+        client.score("echo", ["a,b"], timeout_s=2.0)
+    assert ei.value.worker == "w0"
+
+
+def test_breaker_trips_on_transport_failures_and_halfopen_recovers(traced):
+    s0, b0, w0 = echo_worker("w0")
+    host, port = s0.address
+    router = GlobalRouter([w0], breaker_failures=2, halfopen_ms=50.0,
+                          start_monitor=False)
+    try:
+        s0.stop()                        # refuse connections, batcher lives
+        router.monitor_once()
+        router.monitor_once()
+        assert w0.breaker == OPEN and not w0.routable
+        # a down fleet sheds typed at the door, never hangs
+        with pytest.raises(ShedError):
+            router.submit_nowait("echo", "a,b")
+        # the worker comes back on the same port; past the half-open
+        # window one green healthz poll closes the breaker
+        s0 = ScoreHTTPServer(b0, port=port).start()
+        time.sleep(0.08)
+        router.monitor_once()
+        assert w0.breaker == CLOSED and w0.routable
+        assert router.submit("echo", "z,z") == "z,z,v1"
+    finally:
+        router.close()
+        s0.stop(); b0.close()
+    events = [e["ev"] for e in read_events(traced.journal_path)]
+    assert "fleet.pool.worker.down" in events     # reason="breaker"
+    assert "fleet.pool.worker.up" in events       # reason="probe"
+
+
+# ---------------------------------------------------------------------------
+# the fleet-wide tenant quota at the router door
+# ---------------------------------------------------------------------------
+
+def test_global_tenant_quota_sheds_at_router_door(traced):
+    s0, b0, w0 = echo_worker("w0", delay_s=0.3)
+    contracts = {"alpha": TenantContract(tenant="alpha", share=3.0,
+                                         max_inflight=1)}
+    router = GlobalRouter([w0], contracts=contracts, start_monitor=False)
+    try:
+        with tel.label_scope(tenant="alpha"):
+            held = router.submit_nowait("echo", "a,b")   # takes the quota
+            with pytest.raises(TenantShedError) as ei:
+                router.submit_nowait("echo", "c,d")
+        assert ei.value.tenant == "alpha"
+        assert ei.value.quota == "fleet.max.inflight"
+        assert held.wait(10.0) == "a,b,v1"
+        # the quota released on finish: the next submit admits
+        with tel.label_scope(tenant="alpha"):
+            assert router.submit("echo", "e,f") == "e,f,v1"
+        # an uncontracted tenant is unbounded at the door
+        with tel.label_scope(tenant="beta"):
+            assert router.submit("echo", "g,h") == "g,h,v1"
+    finally:
+        router.close()
+        s0.stop(); b0.close()
+    sheds = [e for e in read_events(traced.journal_path)
+             if e["ev"] == "tenant.shed"]
+    assert any(e["quota"] == "fleet.max.inflight" and e["tenant"] == "alpha"
+               for e in sheds)
+
+
+# ---------------------------------------------------------------------------
+# rolling fleet swap (ready floor) + process autoscale replacement
+# ---------------------------------------------------------------------------
+
+def test_swap_fleet_rolls_every_worker_and_holds_floor(ws, traced):
+    j, churn = ws["j"], ws["churn"]
+    s0, b0, w0 = nb_worker("w0", ws)
+    s1, b1, w1 = nb_worker("w1", ws)
+    router = GlobalRouter([w0, w1], swap_floor=1, start_monitor=False)
+    try:
+        line = read_lines(j("test.csv"))[0]
+        before = router.submit("naiveBayes", line)
+        result = router.swap_fleet(
+            "naiveBayes",
+            {**churn, "bayesian.model.file.path": j("nb_model_v2")})
+        assert result["versions"] == {"w0": 2, "w1": 2}
+        assert result["min_ready"] >= result["floor"] == 1
+        # both planes now score the retrained artifact, byte-identically
+        oconf = JobConfig({**churn,
+                           "bayesian.model.file.path": j("nb_model_v2"),
+                           "serve.models": "naiveBayes",
+                           "serve.bucket.sizes": "1,4",
+                           "serve.flush.deadline.ms": "5"})
+        oc = BucketedMicrobatcher.from_conf(ModelRegistry.from_conf(oconf),
+                                            oconf)
+        want = oc.submit("naiveBayes", line)
+        oc.close()
+        for w in (w0, w1):
+            assert w.client.score("naiveBayes", [line]) == [want]
+        del before
+    finally:
+        router.close()
+        s0.stop(); b0.close(); s1.stop(); b1.close()
+    swaps = [e for e in read_events(traced.journal_path)
+             if e["ev"] == "fleet.pool.swap"]
+    assert {e["worker"] for e in swaps} == {"w0", "w1"}
+    assert all(e["ready"] >= e["floor"] for e in swaps)
+
+
+def test_autoscale_replaces_worker_below_min(traced):
+    s0, b0, w0 = echo_worker("w0")
+    s1, b1, w1 = echo_worker("w1")
+    spawned = []
+
+    def spawner():
+        srv, batcher, worker = echo_worker(f"w{2 + len(spawned)}")
+        spawned.append((srv, batcher))
+        return worker
+
+    router = GlobalRouter([w0, w1], spawner=spawner, autoscale=True,
+                          autoscale_min=2, autoscale_max=3,
+                          breaker_failures=1, start_monitor=False)
+    try:
+        s0.stop()                      # one worker's process plane is gone
+        router.monitor_once()          # breaker opens -> ready < min
+        router.autoscale_once()        # -> replacement spawn (async)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not spawned:
+            time.sleep(0.05)
+        while time.monotonic() < deadline and router._spawning:
+            time.sleep(0.05)
+        assert spawned, "autoscaler never spawned the replacement"
+        stats = router.stats()["fleet"]
+        assert stats["ready"] >= 2
+        assert stats.get("workers.spawned") == 1
+        assert router.submit("echo", "a,b") == "a,b,v1"
+    finally:
+        router.close()
+        s1.stop(); b1.close()
+        for srv, batcher in spawned:
+            srv.stop(); batcher.close()
+        b0.close()
+    events = read_events(traced.journal_path)
+    scales = [e for e in events if e["ev"] == "fleet.pool.scale"]
+    assert any(e["direction"] == "up" and e["reason"] == "replace"
+               for e in scales)
+    ups = [e for e in events if e["ev"] == "fleet.pool.worker.up"]
+    assert any(e["reason"] == "replace" for e in ups)
+
+
+# ---------------------------------------------------------------------------
+# the fresh-subprocess gate: cross-process failover byte-identity
+# ---------------------------------------------------------------------------
+
+def test_subprocess_failover_scores_byte_identical_to_oracle(ws, tmp_path):
+    """Two REAL serving worker processes; w0 is conf-armed to die on its
+    first dispatched batch (``fault.serve.dispatch.crash.after=1`` —
+    its plane answers 503 REPLICA_DOWN, the retryable vouch that the
+    request never scored).  The router re-sends onto w1, and every
+    result — the failed-over request included — must be BYTE-IDENTICAL
+    to the single-plane oracle.  The journal proves the failover hop and
+    that no attempt scored twice."""
+    j, churn = ws["j"], ws["churn"]
+    d = str(tmp_path / "tel")
+    run_id = "gserve"
+    props = {
+        **churn,
+        "bayesian.model.file.path": j("nb_model"),
+        "serve.models": "naiveBayes",
+        "serve.bucket.sizes": "1,4",
+        "serve.flush.deadline.ms": "5",
+        "serve.request.timeout.ms": "10000",
+        "trace.on": "true",
+        "trace.journal.dir": d,
+    }
+    conf_path = str(tmp_path / "serve.properties")
+    with open(conf_path, "w") as fh:
+        fh.write("\n".join(f"{k}={v}" for k, v in props.items()) + "\n")
+
+    from avenir_tpu.launch import ENV_SUFFIX, free_port
+
+    gate = os.path.join(REPO, "tests", "globalserve_worker.py")
+    procs, workers = [], []
+    try:
+        for k, extra in ((0, ["-D", "fault.serve.dispatch.crash.after=1"]),
+                         (1, [])):
+            port = free_port()
+            env = {**os.environ, "PYTHONPATH": REPO,
+                   ENV_SUFFIX: f"w{k}"}
+            proc = subprocess.Popen(
+                [sys.executable, gate, "--conf", conf_path,
+                 "--http-port", str(port),
+                 "-D", f"trace.run.id={run_id}"] + extra,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+            procs.append(proc)
+            workers.append(GlobalWorker(
+                f"w{k}", WorkerClient("127.0.0.1", port, name=f"w{k}"),
+                proc=proc))
+        # wait both planes up (model load + warmup in a fresh interpreter)
+        deadline = time.monotonic() + 300.0
+        for w in workers:
+            while time.monotonic() < deadline:
+                assert w.proc.poll() is None, \
+                    w.proc.communicate()[0].decode()
+                try:
+                    if w.client.healthz(timeout_s=2.0).get("ready"):
+                        break
+                except WorkerDownError:
+                    time.sleep(0.3)
+            else:
+                pytest.fail(f"{w.name} never became ready")
+
+        lines = read_lines(j("test.csv"))[:6]
+        # the single-plane oracle, in-process on the same artifact (trace
+        # keys stripped so it never writes into the fleet's journal dir)
+        conf = JobConfig({k: v for k, v in props.items()
+                          if not k.startswith("trace.")})
+        registry = ModelRegistry.from_conf(conf)
+        oracle = BucketedMicrobatcher.from_conf(registry, conf)
+        want = [oracle.submit("naiveBayes", ln) for ln in lines]
+        oracle.close()
+
+        router = GlobalRouter(workers, failover_retries=1,
+                              start_monitor=False)
+        try:
+            # submit the doomed request first: w0 has 0 inflight and both
+            # depths tie, so least-load picks w0 (insertion order breaks
+            # the tie) — its first batch kills the plane mid-dispatch and
+            # the router must rescue the request onto w1
+            got = [router.submit("naiveBayes", ln, timeout_s=60.0)
+                   for ln in lines]
+            assert got == want                       # byte-identity
+            assert router.counters.as_dict()["Fleet"]["failovers"] >= 1
+        finally:
+            router.close(retire_workers=True)
+        for proc in procs:
+            proc.communicate(timeout=60)
+
+        # the merged fleet journal proves the hop and the accounting
+        from avenir_tpu.launch import merge_fleet_journal
+
+        merged = merge_fleet_journal(d, run_id=run_id)
+        assert merged is not None
+        events = read_events(merged)
+        scored = {}
+        for e in events:
+            if e["ev"] == "span.close" and e.get("name") == "serve.request":
+                rid = (e.get("attrs") or {}).get("rid")
+                if rid and rid.startswith("g"):
+                    scored[rid] = scored.get(rid, 0) + 1
+        assert scored, "no router-rid serve.request spans in the journal"
+        assert all(n == 1 for n in scored.values()), \
+            f"an attempt scored twice: {scored}"
+        # the failed-over base rid holds attempts a0 (w0, died) + a1 (w1)
+        bases = {}
+        for rid in scored:
+            bases.setdefault(rid.rsplit(".a", 1)[0], []).append(rid)
+        assert any(len(rids) >= 1 and any(r.endswith(".a1") for r in rids)
+                   for rids in bases.values())
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()

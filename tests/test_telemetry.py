@@ -368,6 +368,21 @@ def test_golden_event_shapes(tmp_path):
                      burn=1.4, queue_frac=0.6, reason="burn")
         tracer.event("pool.failover", rid="q7", model="naiveBayes",
                      **{"from": "r0", "to": "r1"}, attempt=1)
+        # GlobalServe fleet lifecycle events (round 20): shapes pinned
+        # via the same tracer.event form the GlobalRouter emits them with
+        # (serving/global_pool.py; the REAL producer paths — a SIGKILLed
+        # worker process, breaker trips, process-granularity autoscaling,
+        # cross-process failover, the rolling fleet swap — are exercised
+        # in tests/test_globalserve.py with journal assertions)
+        tracer.event("fleet.pool.worker.down", worker="w0", reason="died",
+                     pending=2)
+        tracer.event("fleet.pool.worker.up", worker="w2", reason="replace")
+        tracer.event("fleet.pool.scale", direction="up", ready=1, total=2,
+                     burn=1.2, queue_frac=0.4, reason="replace")
+        tracer.event("fleet.pool.failover", rid="g7", model="naiveBayes",
+                     **{"from": "w0", "to": "w1"}, attempt=1)
+        tracer.event("fleet.pool.swap", worker="w1", model="naiveBayes",
+                     version=2, ready=2, floor=1)
         # GraftPool tenant events (round 18) ride their REAL publish
         # paths: a 1-quota tenant admits on its first slot, a second
         # same-tenant slot is quota-throttled (spare capacity exists, so
