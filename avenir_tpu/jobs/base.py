@@ -127,9 +127,18 @@ class Job:
         if tracer.enabled:
             attrs = {"conf": StreamCheckpointer.run_id_from_conf(conf),
                      "input": input_path, "output": output_path}
+        # GraftBox: the job body is the launcher worker's heartbeat seam
+        # — a guarded region plus the progress beats from the chunk/pane
+        # folds inside it, so a worker wedged anywhere in execute() trips
+        # hang.detected and captures a bundle (one attribute check when
+        # blackbox.watchdog.sec is unset)
+        from avenir_tpu.telemetry import blackbox
+
         with tel.label_scope(tenant=conf.get("tenant.id")), \
                 tracer.span(f"job.{self.name or type(self).__name__}",
-                            attrs=attrs):
+                            attrs=attrs), \
+                blackbox.watchdog_guard(
+                    f"job.{self.name or type(self).__name__}"):
             self.execute(conf, input_path, output_path, counters)
         # GraftFleet (round 15): journal this job's final counter
         # snapshot under the job name — in a multi-process run EVERY

@@ -149,6 +149,9 @@ class FleetResult:
     workers: List[WorkerResult] = field(default_factory=list)
     exit_code: int = 0
     merged_journal: Optional[str] = None
+    # GraftBox: dead workers' forensics bundles swept at teardown (one
+    # record per bundle: dir/reason/status/events/journaled)
+    bundles: List[dict] = field(default_factory=list)
 
     def output_of(self, rank: int) -> str:
         return next(w.output for w in self.workers if w.rank == rank)
@@ -309,5 +312,13 @@ def launch_local(child_argv: Sequence[str], nprocs: int, *,
             result.exit_code = int(rc)
             break
     if journal_dir:
+        # GraftBox: sweep dead workers' bundles FIRST — the sweep shard's
+        # bundle.written records must exist before the fleet merge reads
+        # the directory, so the merged journal accounts for every death
+        from avenir_tpu.telemetry.blackbox import sweep as _sweep_bundles
+
+        for bb_dir in (journal_dir, os.path.join(journal_dir, "blackbox")):
+            result.bundles.extend(_sweep_bundles(bb_dir,
+                                                 journal_dir=journal_dir))
         result.merged_journal = merge_fleet_journal(journal_dir)
     return result

@@ -127,6 +127,9 @@ def main(argv: List[str]) -> int:
     for w in result.workers:
         print(f"[launch] worker p{w.rank} exit={w.returncode}",
               file=sys.stderr)
+    for b in result.bundles:
+        print(f"[launch] blackbox bundle: {b['dir']} ({b['reason']})",
+              file=sys.stderr)
     if result.merged_journal:
         print(f"[launch] merged fleet journal: {result.merged_journal}",
               file=sys.stderr)

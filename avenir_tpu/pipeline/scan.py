@@ -430,10 +430,16 @@ class ChunkFolder:
         so ONE arbiter hook fair-queues both against every other tenant
         on the device pool.  Un-tenanted runs get the shared null context
         (one attribute check); a tenant past its queue share raises the
-        typed TenantShedError to its OWN workload, never a neighbor's."""
-        from avenir_tpu import tenancy
+        typed TenantShedError to its OWN workload, never a neighbor's.
 
-        with tenancy.pool().slot():
+        GraftBox: the fold is a watchdog-guarded seam — a chunk pass
+        that wedges (stuck collective, dead device) past
+        ``blackbox.watchdog.sec`` journals ``hang.detected`` and captures
+        a forensics bundle (the guard is one attribute check when off)."""
+        from avenir_tpu import tenancy
+        from avenir_tpu.telemetry import blackbox
+
+        with blackbox.watchdog_guard("fold"), tenancy.pool().slot():
             self._fold(ds, acc)
 
     def _fold(self, ds: EncodedDataset, acc: agg.Accumulator) -> None:

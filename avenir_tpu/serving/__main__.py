@@ -130,12 +130,21 @@ def main(argv: List[str]) -> int:
     # SIGTERM is how an orchestrator stops a replica (the GraftFleet
     # deployment shape): without a handler the default action kills the
     # process mid-write and skips the shutdown snapshot below — treat it
-    # exactly like Ctrl-C
+    # exactly like Ctrl-C.  GraftBox first: the forensics bundle latches
+    # with the in-flight table as it stood when the signal landed (no-op
+    # when blackbox.dir is unset), THEN the graceful drain runs.
     import signal
 
+    from avenir_tpu.telemetry import blackbox
+
     stop = threading.Event()
+
+    def _on_term(*_):
+        blackbox.on_signal("SIGTERM")
+        stop.set()
+
     try:
-        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGTERM, _on_term)
     except ValueError:                       # pragma: no cover - non-main
         pass
     try:

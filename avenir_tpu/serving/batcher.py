@@ -59,6 +59,7 @@ from avenir_tpu.serving.errors import (
     TenantShedError,
 )
 from avenir_tpu.serving.registry import ModelRegistry
+from avenir_tpu.telemetry import blackbox
 from avenir_tpu.telemetry import profile as prof_mod
 from avenir_tpu.telemetry import spans as tel
 from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
@@ -193,6 +194,15 @@ class BucketedMicrobatcher:
             for name in registry.names()}
         self._cond = threading.Condition()
         self._stop = False
+        # GraftBox: requests popped from their queues but not yet
+        # scored — with the queues, the in-flight table a forensics
+        # bundle snapshots (rid + tenant + queue age of everything this
+        # replica would strand if it died right now)
+        self._active: List[PendingRequest] = []
+        self._bb_name = f"batcher-{name}" if name else \
+            f"batcher-{id(self):x}"
+        blackbox.register_provider(self._bb_name, self._blackbox_inflight,
+                                   kind="inflight")
         # readiness (GraftFleet round 15): the /healthz probe's contract —
         # a load balancer must not route to a replica whose (model,
         # bucket) shapes are not compiled yet, or the first requests pay
@@ -298,7 +308,15 @@ class BucketedMicrobatcher:
                 shed_depth = len(queue)
             else:
                 queue.append(req)
+                depth = len(queue)
                 self._cond.notify()
+        if shed_depth is None:
+            # GraftBox: the submit door records straight to the flight
+            # ring (trace.on or not, and outside the lock) — a SIGKILLed
+            # replica's bundle shows WHICH rids were in flight
+            blackbox.ring_record("serve.submit",
+                                 {"rid": req.rid, "model": model,
+                                  "tenant": req.tenant, "depth": depth})
         if shed_depth is not None:
             if self.tenant:
                 # tenant-scoped door shed: booked under the tenant (above,
@@ -401,6 +419,7 @@ class BucketedMicrobatcher:
                         batches.append((name,
                                         [queue.popleft()
                                          for _ in range(take)]))
+                    self._active = [r for _, rs in batches for r in rs]
                     self._dispatching = True
                 try:
                     for i, (name, reqs) in enumerate(batches):
@@ -412,7 +431,11 @@ class BucketedMicrobatcher:
                         # deadline is a miss
                         self.heartbeat = time.monotonic()
                         try:
-                            self._dispatch(name, reqs)
+                            # GraftBox: a dispatch that wedges (stuck
+                            # device call, deadlocked arbiter) trips the
+                            # progress watchdog and captures a bundle
+                            with blackbox.watchdog_guard("serve.dispatch"):
+                                self._dispatch(name, reqs)
                         except Exception:  # noqa: BLE001
                             # replica-fatal, injected (serve.dispatch
                             # kill) or real: every unfinished request
@@ -427,6 +450,7 @@ class BucketedMicrobatcher:
                 finally:
                     with self._cond:
                         self._dispatching = False
+                        self._active = []
                         self.heartbeat = time.monotonic()
 
     def _dispatch(self, model: str, reqs: List[PendingRequest]) -> None:
@@ -733,6 +757,24 @@ class BucketedMicrobatcher:
         with self._cond:
             return {name: len(q) for name, q in self._queues.items()}
 
+    def _blackbox_inflight(self) -> List[Dict[str, object]]:
+        """The forensics bundle's in-flight table: every request this
+        replica holds — popped-but-unscored first, then queued — with
+        rid, tenant and queue age (capped: a flooded replica's bundle
+        stays readable)."""
+        now = time.monotonic()
+
+        def row(req: PendingRequest, state: str) -> Dict[str, object]:
+            return {"rid": req.rid, "model": req.model,
+                    "tenant": req.tenant, "state": state,
+                    "age_ms": round((now - req.enqueued) * 1e3, 1)}
+
+        with self._cond:
+            rows = [row(r, "dispatching") for r in self._active]
+            for q in self._queues.values():
+                rows.extend(row(r, "queued") for r in q)
+        return rows[:512]
+
     def close(self) -> None:
         """Flush every pending request, then stop the dispatcher.  A
         dead/wedged dispatcher cannot flush — its leftovers fail typed
@@ -745,6 +787,7 @@ class BucketedMicrobatcher:
         self._thread.join(timeout=60.0)
         if self.fail_pending("batcher closed with a dead dispatcher"):
             self.failed = True
+        blackbox.unregister_provider(self._bb_name)
 
     def __enter__(self) -> "BucketedMicrobatcher":
         return self

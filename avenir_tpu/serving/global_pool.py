@@ -75,6 +75,7 @@ from avenir_tpu.serving.errors import (
     UnknownModelError,
     WorkerDownError,
 )
+from avenir_tpu.telemetry import blackbox
 from avenir_tpu.telemetry import spans as tel
 from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
 
@@ -362,6 +363,10 @@ class GlobalRouter:
             self._poll_worker(w)
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True, name="fleet-monitor")
+        # GraftBox: the router's forensics bundle carries the fleet
+        # routing/breaker table (which workers were routable at death)
+        self._bb_name = f"router-{id(self):x}"
+        blackbox.register_provider(self._bb_name, self._blackbox_state)
         if start_monitor:
             self._monitor.start()
 
@@ -580,6 +585,11 @@ class GlobalRouter:
             self.counters.increment("Fleet", "breaker.trips")
             tel.tracer().event("fleet.pool.worker.down", worker=worker.name,
                                reason="breaker", pending=0)
+            # GraftBox: snapshot what the ROUTER saw the moment the
+            # breaker opened — ring tail, routing table, in-flight rids —
+            # without spending the router's own crash latch (no-op when
+            # blackbox.dir is unset)
+            blackbox.capture(f"breaker:{worker.name}")
 
     # -- supervision (monitor thread; public for deterministic tests) --------
     def monitor_once(self) -> None:
@@ -902,6 +912,17 @@ class GlobalRouter:
         }
         return out
 
+    def _blackbox_state(self) -> List[Dict[str, object]]:
+        """The bundle's fleet-state rows: worker name, routable, breaker
+        state, consecutive failures, in-flight count."""
+        with self._lock:
+            workers = list(self._workers.values())
+        return [{"worker": w.name, "routable": w.routable,
+                 "breaker": w.breaker, "active": w.active,
+                 "alive": not w.dead, "consecutive": w.consecutive,
+                 "inflight": w.inflight}
+                for w in workers]
+
     def close(self, retire_workers: bool = True,
               grace_s: float = 15.0) -> None:
         """Stop supervision and the client pool; with
@@ -911,6 +932,7 @@ class GlobalRouter:
         if self._monitor.is_alive():
             self._monitor.join(timeout=10.0)
         self._pool.shutdown(wait=True)
+        blackbox.unregister_provider(self._bb_name)
         if not retire_workers:
             return
         with self._lock:
@@ -1086,6 +1108,16 @@ def serve_fleet(conf_path: str, nprocs: int, *,
         router.close()
         tel.tracer().counters("fleet", router.counters)
         tel.tracer().disable()
+        # GraftBox: finalize + journal dead workers' bundles BEFORE the
+        # merge, so the merged fleet journal carries exactly one
+        # bundle.written per dead worker (a SIGKILLed worker ran no hook
+        # — its live bundle is all the evidence there is)
+        bb_dir = conf.get("blackbox.dir")
+        if bb_dir:
+            for rec in blackbox.sweep(bb_dir, journal_dir=journal_dir,
+                                      run_id=run_id):
+                print(f"[fleet] blackbox bundle: {rec['dir']} "
+                      f"({rec['reason']})", flush=True)
         merged = merge_fleet_journal(journal_dir, run_id=run_id)
         if merged:
             print(f"[fleet] merged journal: {merged}", flush=True)

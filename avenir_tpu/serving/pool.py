@@ -60,6 +60,7 @@ from avenir_tpu.serving.errors import (
     ServingError,
     ShedError,
 )
+from avenir_tpu.telemetry import blackbox
 from avenir_tpu.telemetry import spans as tel
 from avenir_tpu.utils.metrics import Counters, LatencyTracker, serving_stats
 from avenir_tpu.utils.retry import FaultPlan
@@ -212,6 +213,10 @@ class ReplicaPool:
             else max(self.heartbeat_s / 4.0, 0.02))
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True, name="pool-monitor")
+        # GraftBox: a forensics bundle snapshots this pool's routing/
+        # breaker table (which replicas were routable at death)
+        self._bb_name = f"pool-{id(self):x}"
+        blackbox.register_provider(self._bb_name, self._blackbox_state)
         if start_monitor:
             self._monitor.start()
 
@@ -662,6 +667,16 @@ class ReplicaPool:
         }
         return out
 
+    def _blackbox_state(self) -> List[Dict[str, object]]:
+        """The bundle's pool-state rows: name, routable, breaker state,
+        consecutive failures, queue depth per replica."""
+        with self._lock:
+            replicas = list(self._replicas.values())
+        return [{"replica": r.name, "routable": r.routable,
+                 "breaker": r.breaker, "active": r.active,
+                 "consecutive": r.consecutive, "depth": r.depth()}
+                for r in replicas]
+
     def close(self) -> None:
         """Stop supervision, then drain and close every replica."""
         self._stop_evt.set()
@@ -671,6 +686,7 @@ class ReplicaPool:
             replicas = list(self._replicas.values())
         for r in replicas:
             r.batcher.close()
+        blackbox.unregister_provider(self._bb_name)
 
     def __enter__(self) -> "ReplicaPool":
         return self

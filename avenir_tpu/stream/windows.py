@@ -290,7 +290,17 @@ class WindowedScan:
 
     def close_pane(self) -> List[WindowResult]:
         """Close the current pane (even empty — the time-driven tick),
-        fold it, and emit any window ending here."""
+        fold it, and emit any window ending here.
+
+        GraftBox: a watchdog-guarded seam — a pane close that wedges
+        (encode, fold, or checkpoint stuck) past ``blackbox.watchdog.sec``
+        journals ``hang.detected`` and captures a forensics bundle."""
+        from avenir_tpu.telemetry import blackbox
+
+        with blackbox.watchdog_guard("pane"):
+            return self._close_pane()
+
+    def _close_pane(self) -> List[WindowResult]:
         lines = self._pane_buf
         self._pane_buf = []
         acc = agg.Accumulator()

@@ -45,6 +45,18 @@ Subcommands (the bare ``<journal>`` form keeps rendering the span tree):
   frontend runs).
 - ``regress <bench.json...> --baseline <artifact>`` — the perf-regression
   sentinel (``telemetry/sentinel.py``); exits 0/1/3.
+- ``diff <a.jsonl> <b.jsonl>`` — GraftBox cross-run regression diff
+  (round 21): per-program dispatch-count / wall / ms-per-dispatch / MFU
+  deltas (each side's MFU against its OWN canary peak) and per-stage
+  span wall deltas between two runs' journals, sorted by |Δwall| — the
+  first table to read when a run got slower
+  (docs/runbooks/perf_regression_triage.md).
+- ``bundle <dir>`` — render a GraftBox forensics bundle
+  (``bundle-<run>-<writer>/`` dumped on crash / fatal signal / watchdog
+  trip, or swept from a SIGKILLed worker): cause + writer identity, the
+  flight-ring tail, the slowest still-open span, thread stacks, the
+  in-flight request table and breaker/pool/watchdog state
+  (docs/runbooks/postmortem_triage.md).
 
 Stdlib-only — usable on a machine with no JAX installed.
 """
@@ -290,11 +302,11 @@ def canary_peak_flops(events: List[dict]) -> Optional[float]:
     return _CANARY_FLOPS_PER_CALL / (best / 1e3)
 
 
-def render_profile(events: List[dict],
-                   peak_flops: Optional[float] = None) -> List[str]:
-    """The per-program roofline table from ``program.compiled`` (cost
+def collect_programs(events: List[dict]) -> Dict[str, dict]:
+    """Program key → merged record from ``program.compiled`` (cost
     fields) + ``program.profile`` (cumulative dispatch/wall totals — the
-    LAST event per program wins) events."""
+    LAST event per program wins).  Shared by the ``profile`` table and
+    the ``diff`` cross-run comparison."""
     programs: Dict[str, dict] = {}
     for event in events:
         ev = event.get("ev")
@@ -312,6 +324,15 @@ def render_profile(events: List[dict],
             rec["site"] = event.get("site", rec.get("site", "?"))
             rec["dispatches"] = event.get("dispatches", 0)
             rec["wall_ms"] = event.get("wall_ms", 0.0)
+    return programs
+
+
+def render_profile(events: List[dict],
+                   peak_flops: Optional[float] = None) -> List[str]:
+    """The per-program roofline table from ``program.compiled`` (cost
+    fields) + ``program.profile`` (cumulative dispatch/wall totals — the
+    LAST event per program wins) events."""
+    programs = collect_programs(events)
     if not programs:
         return ["journal carries no program.compiled/profile events "
                 "(profile.on unset, or the run predates GraftProf)"]
@@ -348,6 +369,293 @@ def render_profile(events: List[dict],
     out.append("flops/bytes are XLA cost-model ESTIMATES captured at "
                "compile time, not hardware counters")
     return out
+
+
+# ---------------------------------------------------------------------------
+# GraftBox renderers (round 21): cross-run diff + forensics bundles
+# ---------------------------------------------------------------------------
+
+def stage_walls(events: List[dict]) -> Dict[str, List[float]]:
+    """Span name → [count, total wall ms] over every closed span — the
+    per-stage half of the cross-run diff (``fold``/``pane``/``dispatch``
+    spans are the pipeline stages)."""
+    names: Dict[str, str] = {}
+    agg: Dict[str, List[float]] = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev == "span.open":
+            names[e.get("span", "?")] = e.get("name", "?")
+        elif ev == "span.close":
+            dur = e.get("dur_ms")
+            if isinstance(dur, (int, float)):
+                name = names.get(e.get("span", ""), e.get("name", "?"))
+                row = agg.setdefault(name, [0, 0.0])
+                row[0] += 1
+                row[1] += float(dur)
+    return agg
+
+
+def _program_mfu(rec: dict, peak_flops: Optional[float]) -> Optional[float]:
+    n = rec.get("dispatches", 0)
+    wall_ms = rec.get("wall_ms") or 0.0
+    flops = rec.get("flops")
+    if n and wall_ms > 0 and isinstance(flops, (int, float)) and peak_flops:
+        return 100.0 * flops * n / (wall_ms / 1e3) / peak_flops
+    return None
+
+
+def render_diff(events_a: List[dict], events_b: List[dict],
+                label_a: str = "A", label_b: str = "B") -> List[str]:
+    """The cross-run regression table: per-program dispatch / wall /
+    ms-per-dispatch / MFU deltas (each side's MFU against its OWN canary
+    peak — a slower machine is not a regression) and per-stage span wall
+    deltas, both sorted by |Δwall| so the biggest mover reads first."""
+    progs_a, progs_b = collect_programs(events_a), collect_programs(events_b)
+    peak_a, peak_b = canary_peak_flops(events_a), canary_peak_flops(events_b)
+    out: List[str] = [f"A = {label_a}", f"B = {label_b}", ""]
+
+    def fnum(v: Optional[float], spec: str = ".1f") -> str:
+        return "-" if v is None else format(v, spec)
+
+    keys = sorted(set(progs_a) | set(progs_b),
+                  key=lambda k: -abs((progs_b.get(k, {}).get("wall_ms")
+                                      or 0.0)
+                                     - (progs_a.get(k, {}).get("wall_ms")
+                                        or 0.0)))
+    if keys:
+        out.append(f"{'program':<12} {'disp A':>7} {'disp B':>7} "
+                   f"{'wall A':>9} {'wall B':>9} {'Δwall ms':>9} "
+                   f"{'Δms/disp':>9} {'MFU%A':>6} {'MFU%B':>6}")
+        for key in keys:
+            ra, rb = progs_a.get(key, {}), progs_b.get(key, {})
+            na, nb = ra.get("dispatches", 0), rb.get("dispatches", 0)
+            wa = ra.get("wall_ms") or 0.0
+            wb = rb.get("wall_ms") or 0.0
+            pa = (wa / na) if na else None
+            pb = (wb / nb) if nb else None
+            dper = (pb - pa) if pa is not None and pb is not None else None
+            out.append(
+                f"{key:<12} {na:>7} {nb:>7} {wa:>9.1f} {wb:>9.1f} "
+                f"{wb - wa:>+9.1f} {fnum(dper, '+9.2f') :>9} "
+                f"{fnum(_program_mfu(ra, peak_a), '.2f'):>6} "
+                f"{fnum(_program_mfu(rb, peak_b), '.2f'):>6}")
+        out.append("")
+    else:
+        out.append("no program.compiled/profile events on either side "
+                   "(profile.on unset in both runs); program table empty")
+        out.append("")
+
+    stages_a, stages_b = stage_walls(events_a), stage_walls(events_b)
+    names = sorted(set(stages_a) | set(stages_b),
+                   key=lambda n: -abs(stages_b.get(n, [0, 0.0])[1]
+                                      - stages_a.get(n, [0, 0.0])[1]))
+    if names:
+        out.append(f"{'stage':<28} {'n A':>6} {'n B':>6} "
+                   f"{'wall A':>10} {'wall B':>10} {'Δwall ms':>10}")
+        for name in names:
+            ca, wa = stages_a.get(name, [0, 0.0])
+            cb, wb = stages_b.get(name, [0, 0.0])
+            out.append(f"{name:<28} {ca:>6} {cb:>6} {wa:>10.1f} "
+                       f"{wb:>10.1f} {wb - wa:>+10.1f}")
+    else:
+        out.append("no closed spans on either side (trace.on unset in "
+                   "both runs); stage table empty")
+    out.append("")
+    out.append("Δ = B - A; MFU against each side's own canary peak "
+               + f"(A: {fnum(peak_a and peak_a / 1e12, '.2f')} TFLOP/s, "
+               + f"B: {fnum(peak_b and peak_b / 1e12, '.2f')} TFLOP/s)")
+    return out
+
+
+def diff_cli(rest: List[str]) -> int:
+    """``diff <a.jsonl> <b.jsonl>`` — the cross-run regression diff."""
+    ap = argparse.ArgumentParser(
+        prog="python -m avenir_tpu.telemetry diff",
+        description="Per-program / per-stage dispatch, wall and MFU "
+                    "deltas between two runs' journals (Δ = B - A)")
+    ap.add_argument("a", help="baseline journal (run-*.jsonl or merged "
+                              "fleet view)")
+    ap.add_argument("b", help="candidate journal to compare against it")
+    args = ap.parse_args(rest)
+    try:
+        events_a = read_events(args.a)
+        events_b = read_events(args.b)
+    except OSError as exc:
+        print(f"cannot read journal: {exc}", file=sys.stderr)
+        return 2
+    for line in render_diff(events_a, events_b,
+                            label_a=args.a, label_b=args.b):
+        print(line)
+    return 0
+
+
+def _load_json(path: str, default):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return default
+
+
+def _read_ring(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:       # torn tail: SIGKILL mid-write
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def _ring_line(rec: dict, t_end: float) -> str:
+    fields = " ".join(f"{k}={rec[k]}" for k in rec
+                      if k not in ("ts", "ev"))
+    dt = rec.get("ts", t_end) - t_end
+    return (f"  {dt:>+9.3f}s  {rec.get('ev', '?'):<22}"
+            + (f"  {fields}" if fields else ""))
+
+
+def open_spans_in_ring(ring: List[dict]) -> List[dict]:
+    """``span.open`` entries with no matching ``span.close`` in the ring,
+    oldest first — a wedged run's stuck stage.  Empty when the run traced
+    nothing (``trace.on`` off records no span seams in the ring)."""
+    opens: Dict[str, dict] = {}
+    for rec in ring:
+        ev = rec.get("ev")
+        if ev == "span.open":
+            opens[rec.get("span", "?")] = rec
+        elif ev == "span.close":
+            opens.pop(rec.get("span", ""), None)
+    return sorted(opens.values(), key=lambda r: r.get("ts", 0.0))
+
+
+def render_bundle(bundle_dir: str, tail: int = 20,
+                  stack_lines: int = 40) -> List[str]:
+    """The whole post-mortem from one forensics bundle directory: cause,
+    flight-ring tail, slowest open span, in-flight requests, pool /
+    breaker / watchdog state, device memory, thread stacks."""
+    meta = _load_json(os.path.join(bundle_dir, "meta.json"), {})
+    ring = _read_ring(os.path.join(bundle_dir, "ring.jsonl"))
+    out = [f"bundle {bundle_dir}"]
+    out.append(f"  reason={meta.get('reason') or '?'} "
+               f"status={meta.get('status', '?')} "
+               f"writer={meta.get('writer', '?')} "
+               f"run={meta.get('run', '?')} pid={meta.get('pid', '?')} "
+               f"journaled={meta.get('journaled', False)}")
+    if meta.get("argv"):
+        out.append(f"  argv: {' '.join(str(a) for a in meta['argv'])}")
+    out.append("")
+
+    t_end = ring[-1].get("ts", 0.0) if ring else 0.0
+    shown = ring[-tail:]
+    out.append(f"flight ring — last {len(shown)} of {len(ring)} event(s), "
+               "times relative to the newest:")
+    for rec in shown:
+        out.append(_ring_line(rec, t_end))
+    if not ring:
+        out.append("  (empty)")
+    out.append("")
+
+    open_spans = open_spans_in_ring(ring)
+    if open_spans:
+        oldest = open_spans[0]
+        age = t_end - oldest.get("ts", t_end)
+        out.append(f"slowest open span: {oldest.get('name', '?')} "
+                   f"(span={oldest.get('span', '?')}, open {age:.3f}s "
+                   "before the ring's newest event)")
+        for rec in open_spans[1:]:
+            out.append(f"  also open: {rec.get('name', '?')} "
+                       f"(+{t_end - rec.get('ts', t_end):.3f}s)")
+        out.append("")
+
+    inflight = _load_json(os.path.join(bundle_dir, "inflight.json"), {})
+    rows = [(src, row) for src, got in sorted(inflight.items())
+            for row in (got if isinstance(got, list) else [got])]
+    if rows:
+        out.append(f"in-flight requests ({len(rows)}):")
+        for src, row in rows:
+            if isinstance(row, dict):
+                detail = " ".join(f"{k}={v}" for k, v in row.items())
+            else:
+                detail = str(row)
+            out.append(f"  [{src}] {detail}")
+        out.append("")
+
+    state = _load_json(os.path.join(bundle_dir, "state.json"), {})
+    dog = state.get("watchdog") or {}
+    if dog.get("sec"):
+        active = dog.get("active") or {}
+        sites = " ".join(f"{s}({v.get('active_s', '?')}s)"
+                         for s, v in sorted(active.items()))
+        out.append(f"watchdog: threshold={dog.get('sec')}s "
+                   f"silent={dog.get('silent_s', '?')}s "
+                   f"tripped={dog.get('tripped', False)}"
+                   + (f" active: {sites}" if sites else ""))
+    for src in sorted(state):
+        if src in ("watchdog",):
+            continue
+        got = state[src]
+        if isinstance(got, list):
+            for row in got:
+                detail = (" ".join(f"{k}={v}" for k, v in row.items())
+                          if isinstance(row, dict) else str(row))
+                out.append(f"  [{src}] {detail}")
+        elif got is not None:
+            out.append(f"  [{src}] {json.dumps(got, default=repr)}")
+    if dog.get("sec") or any(s != "watchdog" for s in state):
+        out.append("")
+
+    memory = _load_json(os.path.join(bundle_dir, "memory.json"), {})
+    gauges = memory.get("device_memory") or {}
+    if gauges:
+        out.append("device memory: " + " ".join(
+            f"{k}={v}" for k, v in sorted(gauges.items())))
+        out.append("")
+
+    try:
+        with open(os.path.join(bundle_dir, "stacks.txt"), "r",
+                  encoding="utf-8") as fh:
+            stacks = fh.read().splitlines()
+    except OSError:
+        stacks = []
+    if stacks:
+        out.append("stacks:")
+        for line in stacks[:stack_lines]:
+            out.append(f"  {line}")
+        if len(stacks) > stack_lines:
+            out.append(f"  … {len(stacks) - stack_lines} more line(s) in "
+                       f"{os.path.join(bundle_dir, 'stacks.txt')}")
+    return out
+
+
+def bundle_cli(rest: List[str]) -> int:
+    """``bundle <dir>`` — render a GraftBox forensics bundle."""
+    ap = argparse.ArgumentParser(
+        prog="python -m avenir_tpu.telemetry bundle",
+        description="Render a GraftBox forensics bundle "
+                    "(bundle-<run>-<writer>/) as a post-mortem: cause, "
+                    "flight-ring tail, open spans, in-flight requests, "
+                    "pool/breaker/watchdog state, thread stacks")
+    ap.add_argument("directory", help="bundle-<run>-<writer> directory")
+    ap.add_argument("--tail", type=int, default=20,
+                    help="flight-ring events to show (default 20)")
+    ap.add_argument("--stack-lines", type=int, default=40,
+                    help="stack-trace lines to show (default 40)")
+    args = ap.parse_args(rest)
+    if not os.path.isfile(os.path.join(args.directory, "meta.json")):
+        print(f"{args.directory!r} is not a forensics bundle "
+              "(no meta.json)", file=sys.stderr)
+        return 2
+    for line in render_bundle(args.directory, tail=max(args.tail, 1),
+                              stack_lines=max(args.stack_lines, 1)):
+        print(line)
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -568,7 +876,7 @@ def render_metrics(events: List[dict]) -> str:
 def main(argv: List[str]) -> int:
     # subcommand dispatch with the legacy bare-journal form preserved
     commands = ("tree", "profile", "metrics", "regress", "merge", "skew",
-                "slo")
+                "slo", "diff", "bundle")
     if argv and argv[0] in commands:
         cmd, rest = argv[0], argv[1:]
     else:
@@ -581,6 +889,10 @@ def main(argv: List[str]) -> int:
         return merge_cli(rest)
     if cmd == "slo":
         return slo_cli(rest)
+    if cmd == "diff":
+        return diff_cli(rest)
+    if cmd == "bundle":
+        return bundle_cli(rest)
 
     ap = argparse.ArgumentParser(
         prog=f"python -m avenir_tpu.telemetry {cmd}".rstrip(),

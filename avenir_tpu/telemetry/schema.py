@@ -157,6 +157,16 @@ GOLDEN_EVENT_KEYS: Dict[str, Set[str]] = {
                          "waiting", "inflight"},
     "tenant.shed": {"ev", "ts", "trace", "span", "tenant", "quota",
                     "waiting", "inflight", "retry_after_ms"},
+    # GraftBox (this round): the forensics plane — one record per
+    # finalized crash/hang/signal bundle (self-journaled by the dying
+    # process when tracing is on, else appended by the teardown sweep's
+    # shard — telemetry/blackbox.py), and the progress watchdog's trip
+    # record naming the oldest silent seam — docs/observability.md
+    # event table, docs/runbooks/postmortem_triage.md
+    "bundle.written": {"ev", "ts", "trace", "span", "dir", "reason",
+                       "events"},
+    "hang.detected": {"ev", "ts", "trace", "span", "site", "silent_s",
+                      "threshold"},
     # PlanGraft (round 19): the planner's one record of what it decided
     # before anything executed — unit/stage shape, which rewrites fired,
     # and the summed AOT estimate (null when the backend degraded to

@@ -52,6 +52,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, Iterator, Optional
 
+from avenir_tpu.telemetry import blackbox as _blackbox
 from avenir_tpu.telemetry.journal import Journal
 
 _CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
@@ -351,6 +352,11 @@ class Tracer:
 
     # -- journal shorthands --------------------------------------------------
     def _journal_emit(self, ev: str, **fields) -> None:
+        # GraftBox: every journaled event also lands in the always-on
+        # flight ring (a dead process's last moments survive the journal's
+        # file buffer); copied because the labels/ts mutation below would
+        # otherwise alias the ring's stored record
+        _blackbox.ring_record(ev, dict(fields))
         if self.journal is not None:
             ts = fields.pop("ts", None)
             if ts is not None:
@@ -368,6 +374,10 @@ class Tracer:
         """Journal a free event stamped with the current span's identity
         (if any) — checkpoint saves, canary readings, stage skips."""
         if not self.enabled:
+            # GraftBox: the flight ring records this seam even with
+            # tracing off (the kwargs dict is fresh per call — safe to
+            # keep without a copy); the journal still sees nothing
+            _blackbox.ring_record(ev, fields)
             return
         cur = _CURRENT.get()
         if cur is not None:
@@ -381,6 +391,7 @@ class Tracer:
         seams may announce; later duplicates are dropped, and a run
         carrying genuinely distinct facts (different keys) journals each."""
         if not self.enabled:
+            _blackbox.ring_record(ev, fields)   # ring only; no once-latch
             return
         with self._lock:
             if (ev, key) in self._once:
@@ -398,6 +409,7 @@ class Tracer:
     def gauge(self, name: str, value: float) -> None:
         """Journal a point-in-time gauge reading (queue depths)."""
         if not self.enabled:
+            _blackbox.ring_record("gauge", {"name": name, "value": value})
             return
         self.event("gauge", name=name, value=value)
 
@@ -456,6 +468,11 @@ def configure(conf) -> Tracer:
     from avenir_tpu.telemetry import profile as _profile
 
     _profile.configure(conf)
+    # GraftBox rides the same entry point: blackbox.dir arms the
+    # forensics bundle writer and blackbox.watchdog.sec the progress
+    # watchdog INDEPENDENTLY of trace.on — crash forensics must not
+    # require tracing (a few dict lookups when unset)
+    _blackbox.configure(conf)
     t = _TRACER
     if not conf.get_bool("trace.on", False) or t.enabled:
         return t

@@ -351,6 +351,9 @@ def run_soak_fleet(nprocs=2, bursts=24, p99_target_ms=20000.0,
         "trace.on": "true",
         "trace.journal.dir": root,
         "trace.run.id": run_id,
+        # GraftBox (round 21): every process keeps a live forensics
+        # bundle — the SIGKILLed victim's is the drill's post-mortem
+        "blackbox.dir": j("bb"),
         # the per-tenant SLO gate closes on these over `--label tenant=`
         "slo.p99.metric": "p99.latency.ms",
         "slo.p99.target": str(p99_target_ms),
@@ -426,6 +429,23 @@ def run_soak_fleet(nprocs=2, bursts=24, p99_target_ms=20000.0,
     tel.tracer().counters("fleet", router.counters)
     router.close()                 # SIGTERMs survivors (drain + snapshot)
     tel.tracer().disable()
+
+    # -- the GraftBox post-mortem: the victim MUST have left a bundle ---------
+    # (SIGKILL runs no hook — the flush thread's live bundle is the
+    # record); the sweep journals it before the merge so the fleet view
+    # accounts for the dead worker, then disarms this process's box
+    from avenir_tpu.telemetry import blackbox
+
+    bundle_recs = blackbox.sweep(j("bb"), journal_dir=root, run_id=run_id)
+    blackbox.reset()
+    victim_bundles = [r for r in bundle_recs
+                      if (r.get("writer") or "").endswith("-" + killed)]
+    if not victim_bundles:
+        raise RuntimeError(
+            f"SIGKILLed worker {killed!r} left no forensics bundle under "
+            f"{j('bb')!r} — swept: {bundle_recs}")
+    if not all(r["journaled"] for r in bundle_recs):
+        raise RuntimeError(f"unjournaled bundles after sweep: {bundle_recs}")
 
     # -- the merged fleet journal is the acceptance artifact ------------------
     rc_merge = telemetry_cli(["merge", root, "--run", run_id])
@@ -519,6 +539,8 @@ def run_soak_fleet(nprocs=2, bursts=24, p99_target_ms=20000.0,
         "shed": shed + door_shed,
         "door_shed": door_shed,
         "killed_worker": killed,
+        "victim_bundle": victim_bundles[0]["dir"],
+        "bundles_swept": len(bundle_recs),
         "orphan_scored_spans": orphans,
         "torn_tail_ok": torn_tail_ok,
         "failovers": fleet_stats.get("failovers", 0),

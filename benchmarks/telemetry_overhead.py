@@ -27,6 +27,15 @@ journal shard is ever created off) — and adds
 ``span_ns_on_federated``: the on-state cost when the journal is a
 fleet SHARD (writer stamp on every event + prefixed span ids), so the
 per-event price of per-process attribution is a published number.
+
+Round 21 (GraftBox) adds the flight-ring numbers: ``ring_record_ns`` —
+one bounded-deque append, the cost every emit seam now pays on BOTH
+sides of ``trace.on`` — plus ``event_site_ns_off`` (a disabled
+``tracer().event(...)`` call: the ring append + one enabled check, the
+always-on recorder's whole off-state price) and ``event_site_ns_on``
+(ring append + journal line).  ``span_ns_off`` is measured by the SAME
+code as before the recorder merged — the span sites do not touch the
+ring, so the published off-is-free span bound is unchanged by round 21.
 """
 
 from __future__ import annotations
@@ -38,6 +47,7 @@ import time
 
 import numpy as np
 
+from avenir_tpu.telemetry import blackbox
 from avenir_tpu.telemetry.profile import Profiler
 from avenir_tpu.telemetry.spans import Tracer
 
@@ -52,6 +62,30 @@ def measure_span_ns(tracer: Tracer) -> float:
         for _ in range(SPANS_PER_BATCH):
             with tracer.span("probe"):
                 pass
+        rates.append((time.perf_counter() - t0) / SPANS_PER_BATCH * 1e9)
+    return float(np.median(rates))
+
+
+def measure_ring_record_ns() -> float:
+    """One direct flight-ring append — the GraftBox always-on floor."""
+    rates = []
+    for _ in range(BATCHES):
+        t0 = time.perf_counter()
+        for _ in range(SPANS_PER_BATCH):
+            blackbox.ring_record("probe", None)
+        rates.append((time.perf_counter() - t0) / SPANS_PER_BATCH * 1e9)
+    return float(np.median(rates))
+
+
+def measure_event_ns(t: Tracer) -> float:
+    """One ``.event()`` emit seam: off-state this is the ring append plus
+    the enabled check (the recorder's whole always-on price); on-state it
+    adds the journal line."""
+    rates = []
+    for _ in range(BATCHES):
+        t0 = time.perf_counter()
+        for _ in range(SPANS_PER_BATCH):
+            t.event("probe")
         rates.append((time.perf_counter() - t0) / SPANS_PER_BATCH * 1e9)
     return float(np.median(rates))
 
@@ -71,13 +105,17 @@ def measure() -> dict:
     off = Tracer()                       # never enabled: the default state
     off_ns = measure_span_ns(off)
     prof_off_ns = measure_profile_site_ns(Profiler())
+    ring_ns = measure_ring_record_ns()
+    event_off_ns = measure_event_ns(off)
 
     on = Tracer()
     with tempfile.TemporaryDirectory() as tmp:
         on.enable(tmp)
         on_ns = measure_span_ns(on)
         journal_bytes = os.path.getsize(on.journal_path)
-        on.disable()
+        event_on_ns = measure_event_ns(on)    # after the size read: the
+        on.disable()                          # bytes/span metric is spans-only
+    blackbox.ring_clear()                # drop the probe flood
 
     # federated shard (GraftFleet): writer stamp on every event +
     # prefixed span ids — the per-process-attribution price, on-state
@@ -97,6 +135,9 @@ def measure() -> dict:
         "metric": "telemetry_overhead",
         "span_ns_off": round(off_ns, 1),
         "profile_site_ns_off": round(prof_off_ns, 1),
+        "ring_record_ns": round(ring_ns, 1),
+        "event_site_ns_off": round(event_off_ns, 1),
+        "event_site_ns_on": round(event_on_ns, 1),
         "span_ns_on_journaled": round(on_ns, 1),
         "span_ns_on_federated": round(fed_ns, 1),
         "journal_bytes_per_span": round(journal_bytes

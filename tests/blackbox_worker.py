@@ -1,0 +1,118 @@
+"""Subprocess worker for the GraftBox kill drill (round 21).
+
+Launched by tests/test_blackbox.py with ``trace.on`` UNSET — the whole
+point of the flight recorder is forensics for runs that never paid for
+tracing.  Both modes share ``trace.run.id=bbdrill`` (pinned explicitly:
+the crash mode's ``fault.*`` conf keys would otherwise change the
+fingerprint-derived run id and split the fleet journal) and distinct
+``trace.writer.suffix`` values, so the two dead workers' bundles carry
+distinct writer identities under one run.
+
+Modes (argv[1], argv[2] = scratch root):
+
+- ``sigkill`` — arm GraftBox, train a tiny NB model through the real
+  job, build a real :class:`BucketedMicrobatcher` whose flush deadline
+  never fires, queue rid'd requests under a tenant label, print READY
+  and spin.  The parent polls the LIVE bundle (the flush thread spills
+  it continuously) until the in-flight table shows the rids, then
+  SIGKILLs this process mid-flight — no hook runs; the bundle on disk
+  is the only record.
+- ``crash`` — arm GraftBox, run a :class:`WindowedScan` with a
+  conf-armed injected fold fault that propagates UNCAUGHT: the
+  excepthook writes the final bundle (ring + stacks + state) and the
+  process dies nonzero.
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _configure(root, suffix, extra=None):
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.telemetry import spans as tel
+
+    props = {"blackbox.dir": os.path.join(root, "bb"),
+             "blackbox.flush.sec": "0.05",
+             "trace.run.id": "bbdrill",
+             "trace.writer.suffix": suffix}
+    props.update(extra or {})
+    conf = JobConfig(props)
+    tel.configure(conf)         # arms GraftBox; trace.on stays unset
+    return conf
+
+
+def mode_sigkill(root):
+    import json
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.csv_io import write_csv
+    from avenir_tpu.datagen.churn import CHURN_SCHEMA_JSON, generate_churn
+    from avenir_tpu.jobs import get_job
+    from avenir_tpu.jobs.base import read_lines
+    from avenir_tpu.serving import BucketedMicrobatcher, ModelRegistry
+    from avenir_tpu.telemetry import spans as tel
+
+    _configure(root, "w0")
+    j = lambda *p: os.path.join(root, *p)  # noqa: E731
+    rows = generate_churn(120, seed=7)
+    write_csv(j("train.csv"), rows[:96])
+    write_csv(j("test.csv"), rows[96:])
+    with open(j("churn.json"), "w") as fh:
+        json.dump(CHURN_SCHEMA_JSON, fh)
+    props = {"feature.schema.file.path": j("churn.json")}
+    get_job("BayesianDistribution").run(JobConfig(dict(props)),
+                                        j("train.csv"), j("nb_model"))
+    conf = JobConfig({**props,
+                      "bayesian.model.file.path": j("nb_model"),
+                      "serve.models": "naiveBayes",
+                      # one huge bucket + an unreachable deadline: the
+                      # queued rids never drain, so they ARE the
+                      # in-flight table when the SIGKILL lands
+                      "serve.bucket.sizes": "64",
+                      "serve.flush.deadline.ms": "60000",
+                      "serve.queue.depth": "64"})
+    registry = ModelRegistry.from_conf(conf)
+    batcher = BucketedMicrobatcher.from_conf(registry, conf)
+    lines = read_lines(j("test.csv"))
+    with tel.label_scope(tenant="drill-tenant"):
+        for i, line in enumerate(lines[:6]):
+            batcher.submit_nowait("naiveBayes", line, rid=f"drill-{i}")
+    print("READY", flush=True)
+    time.sleep(300)             # the parent SIGKILLs us long before this
+    raise AssertionError("parent never killed the sigkill worker")
+
+
+def mode_crash(root):
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.pipeline import scan
+    from avenir_tpu.stream.windows import WindowedScan
+    from avenir_tpu.utils.retry import FaultPlan
+
+    # fault.* rides the SAME conf the blackbox arms from — proving the
+    # pinned trace.run.id keeps both drill workers in one fleet run
+    conf = _configure(root, "w1", extra={"fault.fold.crash.after": "2"})
+    from reshard_worker import build_inputs     # same-directory helper
+
+    enc, lines = build_inputs(n=300, f=3, b=4, c=2, fc=1)
+    ws = WindowedScan(enc, [scan.NaiveBayesConsumer(name="nb")],
+                      pane_rows=128, window_panes=2, slide_panes=1,
+                      fault=FaultPlan.from_conf(conf))
+    ws.feed(lines)              # InjectedFault propagates UNCAUGHT
+    raise AssertionError("injected fold fault never fired")
+
+
+def main():
+    mode, root = sys.argv[1], sys.argv[2]
+    if mode == "sigkill":
+        mode_sigkill(root)
+    elif mode == "crash":
+        mode_crash(root)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,416 @@
+"""GraftBox (round 21): the always-on flight recorder, forensics
+bundles, the progress watchdog, the teardown sweep — and the
+ISSUE-specified kill drill: a SIGKILLed serving worker (no hook runs)
+and a crashing pipeline worker, both with ``trace.on`` UNSET, each
+leaving a bundle the sweep journals exactly once into one merged fleet
+view, rendered end-to-end by ``telemetry bundle``.
+
+In-process tests always ``blackbox.reset()`` in teardown — the box
+installs process-global hooks (excepthook/SIGTERM) that must not leak
+into other tests.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.telemetry import blackbox
+from avenir_tpu.telemetry import spans as tel
+from avenir_tpu.telemetry import __main__ as cli
+
+
+@pytest.fixture(autouse=True)
+def _clean_box():
+    blackbox.ring_clear()
+    yield
+    blackbox.reset()
+    blackbox.ring_clear()
+
+
+# ---------------------------------------------------------------------------
+# the flight ring
+# ---------------------------------------------------------------------------
+
+def test_ring_records_oldest_first_and_bounded():
+    for i in range(5):
+        blackbox.ring_record("probe", {"i": i})
+    snap = blackbox.ring_snapshot()
+    assert [r["i"] for r in snap] == [0, 1, 2, 3, 4]
+    assert all(r["ev"] == "probe" and r["ts"] > 0 for r in snap)
+    # resize keeps the newest tail; the floor is 16
+    blackbox._ring_resize(16)
+    for i in range(40):
+        blackbox.ring_record("flood", {"i": i})
+    snap = blackbox.ring_snapshot()
+    assert len(snap) == 16 and snap[-1]["i"] == 39 and snap[0]["i"] == 24
+    blackbox._ring_resize(blackbox.DEFAULT_RING_EVENTS)
+
+
+def test_emit_seams_record_with_tracing_off():
+    """Every tracer emit seam lands in the ring even though the journal
+    sees nothing — the recorder half of the GraftBox contract."""
+    t = tel.Tracer()                    # never enabled
+    t.event("checkpoint.save", scope="s", run="r")
+    t.event_once("shard.topology", key="k", devices=8)
+    t.gauge("serve.queue.depth", 3.0)
+    evs = [r["ev"] for r in blackbox.ring_snapshot()]
+    assert "checkpoint.save" in evs
+    assert "shard.topology" in evs
+    assert "gauge" in evs
+    assert t.journal is None            # nothing journaled
+
+
+def test_off_state_span_site_unchanged():
+    """The span sites do NOT touch the ring: disabled ``span()`` still
+    returns the shared NOOP object (the published off-is-free bound is
+    the same one-attribute-check site as before round 21)."""
+    t = tel.Tracer()
+    before = len(blackbox.ring_snapshot())
+    s = t.span("probe")
+    assert s is tel.NOOP_SPAN
+    with t.span("probe"):
+        pass
+    assert len(blackbox.ring_snapshot()) == before
+
+
+# ---------------------------------------------------------------------------
+# the progress watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_trips_once_per_excursion():
+    wd = blackbox.Watchdog()
+    wd.sec = 0.05
+    wd.enter("fold")
+    wd.enter("serve.dispatch")
+    try:
+        wd.last_progress = time.monotonic() - 1.0
+        # the oldest silent seam is named; exactly one trip per excursion
+        wd._guards["fold"][1] -= 5.0
+        wd.check_once()
+        hangs = [r for r in blackbox.ring_snapshot()
+                 if r["ev"] == "hang.detected"]
+        assert len(hangs) == 1
+        assert hangs[0]["site"] == "fold"
+        assert hangs[0]["silent_s"] >= 0.05
+        assert hangs[0]["threshold"] == 0.05
+        wd.last_progress = time.monotonic() - 1.0
+        wd.check_once()                 # still the same excursion
+        assert len([r for r in blackbox.ring_snapshot()
+                    if r["ev"] == "hang.detected"]) == 1
+        wd.beat()                       # progress resumed
+        wd.check_once()
+        assert wd.snapshot()["tripped"] is False
+    finally:
+        wd.exit("serve.dispatch")
+        wd.exit("fold")
+
+
+def test_watchdog_guard_off_is_shared_nullcontext():
+    assert blackbox.watchdog_guard("fold") is blackbox._NULL_GUARD
+    snap = blackbox.Watchdog().snapshot()
+    assert snap["active"] == {} and snap["sec"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the bundle writer
+# ---------------------------------------------------------------------------
+
+def _arm(tmp_path, **extra):
+    props = {"blackbox.dir": str(tmp_path / "bb"),
+             "blackbox.flush.sec": "0",     # no flusher thread in-process
+             "trace.run.id": "boxtest"}
+    props.update({k: str(v) for k, v in extra.items()})
+    conf = JobConfig(props)
+    blackbox.configure(conf)
+    return conf
+
+
+def test_arm_finalize_and_latch(tmp_path):
+    _arm(tmp_path)
+    box = blackbox.box()
+    assert box.armed and os.path.isdir(box.bundle_path)
+    assert blackbox.read_meta(box.bundle_path)["status"] == "live"
+    blackbox.ring_record("serve.submit", {"rid": "r-1", "model": "nb",
+                                          "tenant": "t0", "depth": 1})
+    path = blackbox.finalize("crash:TestError", "Traceback: boom")
+    assert path == box.bundle_path
+    for name in ("ring.jsonl", "stacks.txt", "inflight.json", "state.json",
+                 "memory.json", "conf.json", "meta.json"):
+        assert os.path.isfile(os.path.join(path, name)), name
+    meta = blackbox.read_meta(path)
+    assert meta["status"] == "final"
+    assert meta["reason"] == "crash:TestError"
+    assert meta["journaled"] is False        # tracing off
+    assert meta["events"] > 0
+    assert "Traceback: boom" in open(os.path.join(path, "stacks.txt")).read()
+    # exactly one ring entry for the latch, and the latch holds
+    ring = [r for r in blackbox.ring_snapshot()
+            if r["ev"] == "bundle.written"]
+    assert len(ring) == 1 and ring[0]["dir"] == path
+    assert blackbox.finalize("crash:Second") is None
+
+
+def test_capture_is_non_latching(tmp_path):
+    _arm(tmp_path)
+    box = blackbox.box()
+    first = blackbox.capture("breaker:w0")
+    second = blackbox.capture("breaker:w1")
+    assert first == box.bundle_path + "-c1"
+    assert second == box.bundle_path + "-c2"
+    assert blackbox.read_meta(first)["reason"] == "breaker:w0"
+    # captures spend no latch: a later crash still finalizes
+    assert blackbox.finalize("crash:Later") == box.bundle_path
+
+
+def test_unarmed_configure_is_inert(tmp_path):
+    blackbox.configure(JobConfig({}))        # no blackbox.dir
+    assert not blackbox.box().armed
+    assert blackbox.finalize("crash:Nope") is None
+    assert blackbox.capture("breaker:x") is None
+
+
+def test_bundle_journaled_when_tracing_on(tmp_path):
+    """With trace.on, finalize itself journals bundle.written (golden
+    schema) and marks the bundle journaled so the sweep never doubles."""
+    conf = JobConfig({"blackbox.dir": str(tmp_path / "bb"),
+                      "blackbox.flush.sec": "0",
+                      "trace.on": "true",
+                      "trace.journal.dir": str(tmp_path / "tel"),
+                      "trace.run.id": "boxtest"})
+    tel.configure(conf)
+    try:
+        path = blackbox.finalize("crash:Traced")
+        assert blackbox.read_meta(path)["journaled"] is True
+    finally:
+        journal_path = tel.tracer().journal_path
+        tel.tracer().disable()
+    from avenir_tpu.telemetry.journal import read_events
+
+    written = [e for e in read_events(journal_path)
+               if e.get("ev") == "bundle.written"]
+    assert len(written) == 1
+    assert written[0]["dir"] == path and written[0]["reason"] == "crash:Traced"
+
+
+def test_sweep_journals_each_dead_bundle_exactly_once(tmp_path):
+    bb = tmp_path / "bb" / "bundle-r1-proc-0-wx"
+    bb.mkdir(parents=True)
+    dead_pid = 2 ** 22 + 12345               # beyond pid_max: never alive
+    bb.joinpath("meta.json").write_text(json.dumps(
+        {"status": "live", "reason": "", "pid": dead_pid, "run": "r1",
+         "writer": "proc-0-wx", "journaled": False, "events": 7}))
+    tel_dir = tmp_path / "tel"
+    recs = blackbox.sweep(str(tmp_path / "bb"), journal_dir=str(tel_dir),
+                          run_id="r1")
+    assert len(recs) == 1
+    assert recs[0]["status"] == "swept" and recs[0]["reason"] == "killed"
+    assert recs[0]["journaled"] is True
+    meta = blackbox.read_meta(str(bb))
+    assert meta["status"] == "swept" and meta["journaled"] is True
+    # idempotent: a second sweep reports but never re-journals
+    recs2 = blackbox.sweep(str(tmp_path / "bb"), journal_dir=str(tel_dir),
+                           run_id="r1")
+    assert len(recs2) == 1
+    from avenir_tpu.telemetry.journal import read_events
+
+    shards = [n for n in os.listdir(tel_dir) if n.endswith("-sweep.jsonl")]
+    assert len(shards) == 1
+    events = read_events(str(tel_dir / shards[0]))
+    assert [e["ev"] for e in events] == ["bundle.written"]
+    assert events[0]["events"] == 7
+
+
+def test_sweep_skips_live_bundles_of_running_processes(tmp_path):
+    bb = tmp_path / "bb" / "bundle-r1-proc-0-live"
+    bb.mkdir(parents=True)
+    bb.joinpath("meta.json").write_text(json.dumps(
+        {"status": "live", "pid": os.getpid(), "run": "r1",
+         "writer": "proc-0-live", "journaled": False, "events": 1}))
+    assert blackbox.sweep(str(tmp_path / "bb")) == []
+
+
+# ---------------------------------------------------------------------------
+# the CLI renderers
+# ---------------------------------------------------------------------------
+
+def test_bundle_cli_renders_postmortem(tmp_path, capsys):
+    _arm(tmp_path)
+    blackbox.ring_record("span.open", {"span": "s1", "name": "fold"})
+    blackbox.ring_record("serve.submit", {"rid": "drill-0", "model": "nb",
+                                          "tenant": "t0", "depth": 1})
+    blackbox.register_provider(
+        "batcher-t", lambda: [{"rid": "drill-0", "model": "nb",
+                               "tenant": "t0", "state": "queued",
+                               "age_ms": 9}], kind="inflight")
+    try:
+        path = blackbox.finalize("crash:CliTest", "Traceback: cli")
+    finally:
+        blackbox.unregister_provider("batcher-t")
+    assert cli.main(["bundle", path]) == 0
+    out = capsys.readouterr().out
+    assert "reason=crash:CliTest" in out
+    assert "serve.submit" in out and "rid=drill-0" in out
+    assert "slowest open span: fold" in out
+    assert "[batcher-t] rid=drill-0" in out and "state=queued" in out
+    assert "Traceback: cli" in out
+    # a non-bundle directory refuses with a usage error
+    assert cli.main(["bundle", str(tmp_path)]) == 2
+
+
+def test_diff_cli_per_program_and_stage_deltas(tmp_path, capsys):
+    def journal(name, wall, dur):
+        path = tmp_path / name
+        events = [
+            {"ev": "canary", "ms": 2.0},
+            {"ev": "program.compiled", "key": "scan/0", "site": "fold",
+             "flops": 1e9},
+            {"ev": "program.profile", "key": "scan/0", "site": "fold",
+             "dispatches": 10, "wall_ms": wall},
+            {"ev": "span.open", "span": "s1", "name": "fold", "ts": 1.0},
+            {"ev": "span.close", "span": "s1", "dur_ms": dur},
+        ]
+        path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        return str(path)
+
+    a = journal("a.jsonl", wall=50.0, dur=40.0)
+    b = journal("b.jsonl", wall=80.0, dur=70.0)
+    assert cli.main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "scan/0" in out and "+30.0" in out      # program wall delta
+    assert "fold" in out                           # stage row
+    assert "MFU" in out and "canary peak" in out
+    # stage delta +30 appears in the stage table too
+    assert out.count("+30.0") >= 2
+
+
+def test_stage_walls_maps_span_names():
+    events = [{"ev": "span.open", "span": "a", "name": "fold"},
+              {"ev": "span.close", "span": "a", "dur_ms": 5.0},
+              {"ev": "span.open", "span": "b", "name": "fold"},
+              {"ev": "span.close", "span": "b", "dur_ms": 7.0},
+              {"ev": "span.open", "span": "c", "name": "open-forever"}]
+    walls = cli.stage_walls(events)
+    assert walls == {"fold": [2, 12.0]}
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE kill drill: fresh subprocesses, trace.on UNSET
+# ---------------------------------------------------------------------------
+
+def _worker_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("AVENIR_PROCESS_ID", None)
+    env.pop("AVENIR_WRITER_SUFFIX", None)
+    return env
+
+
+def _wait_for_inflight(bundle, rid, timeout_s=60.0):
+    """Poll the LIVE bundle's continuously-spilled in-flight table until
+    the queued rid shows — the kill lands mid-flight by construction."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with open(os.path.join(bundle, "inflight.json"),
+                      encoding="utf-8") as fh:
+                tables = json.load(fh)
+        except (OSError, ValueError):
+            tables = {}
+        for rows in tables.values():
+            if isinstance(rows, list) and any(
+                    isinstance(r, dict) and r.get("rid") == rid
+                    for r in rows):
+                return tables
+        time.sleep(0.1)
+    raise AssertionError(f"{rid} never showed in {bundle}/inflight.json")
+
+
+def test_kill_drill_subprocess(tmp_path, capsys):
+    """The acceptance drill: one worker SIGKILLed mid-flight (no hook
+    runs — the flush thread's live bundle is the record), one dying on
+    an armed ``fault.*`` crash (the excepthook writes the bundle), both
+    with ``trace.on`` unset.  The sweep journals exactly one
+    ``bundle.written`` per dead worker into one merged fleet view, and
+    ``telemetry bundle`` renders the victim's post-mortem, in-flight
+    rids included."""
+    worker = os.path.join(os.path.dirname(__file__), "blackbox_worker.py")
+    env = _worker_env()
+    bb_dir = str(tmp_path / "bb")
+
+    # worker 1: uncaught InjectedFault → excepthook bundle, exit != 0
+    crash = subprocess.run([sys.executable, worker, "crash", str(tmp_path)],
+                           env=env, capture_output=True, text=True,
+                           timeout=300)
+    assert crash.returncode != 0
+    assert "InjectedFault" in crash.stderr, crash.stderr
+
+    # worker 2: queued rids, then SIGKILL — no hook runs
+    proc = subprocess.Popen(
+        [sys.executable, worker, "sigkill", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        seen = []
+        for line in proc.stdout:        # training chatter may precede it
+            seen.append(line)
+            if "READY" in line:
+                break
+        else:
+            raise AssertionError(
+                f"worker exited before READY:\n{''.join(seen)}"
+                f"{proc.stderr.read()}")
+        victim = os.path.join(bb_dir, "bundle-bbdrill-proc-0-w0")
+        _wait_for_inflight(victim, "drill-0")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+
+    bundles = sorted(os.listdir(bb_dir))
+    assert bundles == ["bundle-bbdrill-proc-0-w0",
+                       "bundle-bbdrill-proc-0-w1"], bundles
+    crash_meta = blackbox.read_meta(os.path.join(
+        bb_dir, "bundle-bbdrill-proc-0-w1"))
+    assert crash_meta["status"] == "final"
+    assert crash_meta["reason"].startswith("crash:InjectedFault")
+    assert blackbox.read_meta(victim)["status"] == "live"   # SIGKILL: no hook
+
+    # teardown sweep + fleet merge: exactly one bundle.written per dead
+    # worker in the merged view
+    tel_dir = str(tmp_path / "tel")
+    recs = blackbox.sweep(bb_dir, journal_dir=tel_dir, run_id="bbdrill")
+    assert sorted(r["writer"] for r in recs) == ["proc-0-w0", "proc-0-w1"]
+    assert all(r["journaled"] for r in recs)
+    assert blackbox.read_meta(victim)["reason"] == "killed"
+    from avenir_tpu.launch import merge_fleet_journal
+    from avenir_tpu.telemetry.journal import read_events
+
+    merged = merge_fleet_journal(tel_dir)
+    assert merged
+    written = [e for e in read_events(merged)
+               if e.get("ev") == "bundle.written"]
+    assert sorted(os.path.basename(e["dir"]) for e in written) == bundles
+    # the victim's ring made it into its bundle with the in-flight rids
+    ring = [json.loads(ln) for ln in
+            open(os.path.join(victim, "ring.jsonl"), encoding="utf-8")
+            if ln.strip()]
+    submits = [r for r in ring if r.get("ev") == "serve.submit"]
+    assert {r["rid"] for r in submits} >= {f"drill-{i}" for i in range(6)}
+    assert all(r.get("tenant") == "drill-tenant" for r in submits)
+
+    # the post-mortem renders end-to-end
+    assert cli.main(["bundle", victim]) == 0
+    out = capsys.readouterr().out
+    assert "reason=killed" in out
+    assert "rid=drill-0" in out and "tenant=drill-tenant" in out
+    assert "[batcher-" in out                  # in-flight provider table

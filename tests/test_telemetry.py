@@ -383,6 +383,14 @@ def test_golden_event_shapes(tmp_path):
                      **{"from": "w0", "to": "w1"}, attempt=1)
         tracer.event("fleet.pool.swap", worker="w1", model="naiveBayes",
                      version=2, ready=2, floor=1)
+        # GraftBox events (round 21): shapes pinned via the same
+        # tracer.event form the box emits them with (telemetry/
+        # blackbox.py; the REAL producer paths — a finalize with tracing
+        # on, a watchdog trip — are exercised in tests/test_blackbox.py)
+        tracer.event("bundle.written", dir="/tmp/bb/bundle-r-proc-0",
+                     reason="crash:TestError", events=12)
+        tracer.event("hang.detected", site="serve.dispatch", silent_s=5.2,
+                     threshold=5.0)
         # GraftPool tenant events (round 18) ride their REAL publish
         # paths: a 1-quota tenant admits on its first slot, a second
         # same-tenant slot is quota-throttled (spare capacity exists, so
